@@ -1,0 +1,22 @@
+"""Pytest bootstrap: force an 8-device virtual CPU mesh before JAX loads.
+
+Multi-chip TPU hardware is not available in CI; all mesh/sharding tests run on
+8 virtual CPU devices (the driver separately dry-run-compiles the multi-chip
+path via __graft_entry__.dryrun_multichip).  These env vars must be set before
+the first `import jax` anywhere in the test process.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the ambient TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The container's sitecustomize imports jax at interpreter startup, so the
+# env vars above are too late for jax.config's env-read defaults — but the
+# backend itself is initialized lazily, so a config update still lands.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,25 @@
+"""crdt_tpu — a TPU-native CRDT framework (JAX / XLA / Pallas / pjit).
+
+A from-scratch re-design of the capabilities of the reference system
+(`anuragsarkar97/crdt`, a Go gossip-based eventually-consistent replicated
+key-value counter store — see SURVEY.md) as pure-functional array lattices:
+
+- ``crdt_tpu.models``   — CRDT lattices encoded as fixed-shape arrays
+  (G-Counter, PN-Counter, LWW-Register, OR-Set, and the flagship ``oplog``
+  store that reproduces the reference's op-log/merge/rebuild semantics).
+- ``crdt_tpu.ops``      — jitted join kernels: elementwise-max, timestamp
+  argmax, sorted-segment union (XLA fallback + Pallas bitonic-merge kernel).
+- ``crdt_tpu.parallel`` — anti-entropy over the device mesh: vmapped swarm
+  gossip, shard_map joins, all-reduce convergence over ICI.
+- ``crdt_tpu.oracle``   — pure-Python reference-semantics oracle (with the
+  reference's quirks togglable) used as ground truth for parity tests.
+- ``crdt_tpu.api``      — replica/cluster host API + an HTTP shim exposing
+  the same five endpoints as the reference server.
+- ``crdt_tpu.harness``  — workload generator, soak/convergence harness,
+  benchmark suite.
+- ``crdt_tpu.utils``    — interning, clocks, config, checkpointing, metrics.
+"""
+
+__version__ = "0.1.0"
+
+from crdt_tpu.utils import constants  # noqa: F401

@@ -1,0 +1,1 @@
+from crdt_tpu.models import gcounter, pncounter, lww, orset, oplog  # noqa: F401

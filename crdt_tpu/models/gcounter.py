@@ -1,0 +1,47 @@
+"""G-Counter: grow-only counter lattice, array-encoded for TPU.
+
+Capability parity: each key of the reference store accumulates integer deltas
+(/root/reference/main.go:195-206), i.e. behaves as a PN-Counter; the G-Counter
+is its increment-only half and the simplest lattice exercising the whole join
+machinery (it is also the BASELINE.md headline config).
+
+Encoding
+--------
+``counts: int32[..., n_nodes]`` — one slot per writer node, leading axes batch
+replicas (a (replicas, nodes) plane joins a million replicas in one
+``jnp.maximum``).  join = elementwise max (the classic state-based G-Counter
+join); value = sum over the node axis.  join is commutative, associative and
+idempotent by construction — see tests/test_lattice_laws.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class GCounter:
+    counts: jax.Array  # int32[..., n_nodes]
+
+    @property
+    def n_nodes(self) -> int:
+        return self.counts.shape[-1]
+
+
+def zero(n_nodes: int, batch: tuple = (), dtype=jnp.int32) -> GCounter:
+    """Identity element of join: the all-zero counter."""
+    return GCounter(counts=jnp.zeros((*batch, n_nodes), dtype))
+
+
+def increment(c: GCounter, node, amount=1) -> GCounter:
+    """Local op: node `node` adds `amount` (must be >= 0) to its slot."""
+    return GCounter(counts=c.counts.at[..., node].add(amount))
+
+
+def join(a: GCounter, b: GCounter) -> GCounter:
+    return GCounter(counts=jnp.maximum(a.counts, b.counts))
+
+
+def value(c: GCounter) -> jax.Array:
+    return c.counts.sum(axis=-1)

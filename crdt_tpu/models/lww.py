@@ -1,0 +1,83 @@
+"""LWW-Register: last-writer-wins register lattice, array-encoded for TPU.
+
+Capability parity: the reference resolves non-numeric values per key by
+newest-timestamp-wins during the state rebuild (reverse log iteration,
+/root/reference/main.go:77-85) and breaks equal-timestamp collisions in favour
+of the local log (main.go:54-65).  The TPU-native register makes the tiebreak
+deterministic and replica-order-independent by ordering on the pair
+(ts, replica_id) lexicographically — the reference's local-wins tiebreak is
+available as ``semantics="local"`` for the quirk-compat oracle path.
+
+Encoding
+--------
+``ts, rid, payload: int32[...]`` — leading axes batch registers/replicas.
+``payload`` is a host-interned value id (TPUs don't do strings; see
+crdt_tpu.utils.intern).  join = lexicographic (ts, rid) argmax, realized as a
+``jnp.where`` select so a (100K,) batch resolves in one fused kernel
+(BASELINE.md LWW config).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from crdt_tpu.utils.constants import TS_NULL
+
+
+@struct.dataclass
+class LWWRegister:
+    ts: jax.Array       # int32[...]  (ms offset from host epoch; -1 = unset)
+    rid: jax.Array      # int32[...]  (writer replica id; tiebreak key)
+    payload: jax.Array  # int32[...]  (interned value id)
+
+
+def zero(batch: tuple = (), dtype=jnp.int32) -> LWWRegister:
+    return LWWRegister(
+        ts=jnp.full(batch, TS_NULL, dtype),
+        rid=jnp.full(batch, -1, dtype),
+        payload=jnp.zeros(batch, dtype),
+    )
+
+
+def write(reg: LWWRegister, ts, rid, payload) -> LWWRegister:
+    """Local op: overwrite if (ts, rid) is newer than the stored pair
+    (a stale local write loses, keeping `write` monotone in the lattice)."""
+    new = LWWRegister(
+        ts=jnp.broadcast_to(jnp.asarray(ts, reg.ts.dtype), reg.ts.shape),
+        rid=jnp.broadcast_to(jnp.asarray(rid, reg.rid.dtype), reg.rid.shape),
+        payload=jnp.broadcast_to(jnp.asarray(payload, reg.payload.dtype), reg.payload.shape),
+    )
+    return join(reg, new)
+
+
+def join(a: LWWRegister, b: LWWRegister) -> LWWRegister:
+    """Lexicographic (ts, rid) max-select.  Commutative/associative/idempotent
+    because (ts, rid) is a total order over writes."""
+    b_newer = (b.ts > a.ts) | ((b.ts == a.ts) & (b.rid > a.rid))
+    return LWWRegister(
+        ts=jnp.where(b_newer, b.ts, a.ts),
+        rid=jnp.where(b_newer, b.rid, a.rid),
+        payload=jnp.where(b_newer, b.payload, a.payload),
+    )
+
+
+def join_local_wins(local: LWWRegister, remote: LWWRegister) -> LWWRegister:
+    """Reference tiebreak: on equal timestamp keep the local entry
+    (/root/reference/main.go:54-65).  NOT a lattice join (not commutative);
+    provided only for quirk-compat experiments — the oracle is the real
+    parity surface for this behaviour."""
+    remote_newer = remote.ts > local.ts
+    return LWWRegister(
+        ts=jnp.where(remote_newer, remote.ts, local.ts),
+        rid=jnp.where(remote_newer, remote.rid, local.rid),
+        payload=jnp.where(remote_newer, remote.payload, local.payload),
+    )
+
+
+def value(reg: LWWRegister) -> jax.Array:
+    return reg.payload
+
+
+def is_set(reg: LWWRegister) -> jax.Array:
+    return reg.ts != TS_NULL

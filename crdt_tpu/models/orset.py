@@ -1,0 +1,114 @@
+"""OR-Set: observed-remove set lattice, array-encoded for TPU.
+
+The reference has no set type, but BASELINE.json names the OR-Set as the
+hardest target config (1M replicas × 1K elements, Pallas sorted-segment
+union); it generalizes the reference's grow-only op-log union
+(/root/reference/main.go:49-73) to add/remove semantics.
+
+Encoding
+--------
+A capacity-bounded table of *add-tags*: each `add(elem)` creates a globally
+unique tag ``(rid, seq)`` attached to ``elem``; `remove(elem)` tombstones all
+currently-observed tags of ``elem`` (observed-remove: a concurrent re-add with
+a fresh tag survives).  Rows are sorted by (elem, rid, seq); padding rows have
+all three key columns = SENTINEL.  join = sorted union of the tag tables with
+tombstone-OR on duplicates — tombstoning is monotone (False → True), so the
+join is a lattice join.
+
+Capacity contract: a set holds at most `capacity` live tags; a join whose true
+union exceeds capacity drops the largest (elem, rid, seq) keys.  Use
+``join_checked`` when overflow must be detected host-side.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from crdt_tpu.ops import sorted_union as su
+from crdt_tpu.utils.constants import SENTINEL
+
+
+@struct.dataclass
+class ORSet:
+    elem: jax.Array     # int32[C]  interned element id
+    rid: jax.Array      # int32[C]  tag: creating replica
+    seq: jax.Array      # int32[C]  tag: per-replica sequence number
+    removed: jax.Array  # bool[C]   tombstone flag (monotone)
+
+    @property
+    def capacity(self) -> int:
+        return self.elem.shape[-1]
+
+
+def empty(capacity: int) -> ORSet:
+    s = jnp.full((capacity,), SENTINEL, jnp.int32)
+    return ORSet(elem=s, rid=s, seq=s, removed=jnp.zeros((capacity,), bool))
+
+
+def size(s: ORSet) -> jax.Array:
+    """Number of live (non-padding) tag rows."""
+    return jnp.sum(s.elem != SENTINEL).astype(jnp.int32)
+
+
+@jax.jit
+def add(s: ORSet, elem, rid, seq) -> ORSet:
+    """Insert a fresh add-tag.  Requires a free slot (the last row must be
+    padding, else the largest key is evicted — see capacity contract)."""
+    elem = jnp.asarray(elem, jnp.int32)
+    new = ORSet(
+        elem=s.elem.at[-1].set(elem),
+        rid=s.rid.at[-1].set(jnp.asarray(rid, jnp.int32)),
+        seq=s.seq.at[-1].set(jnp.asarray(seq, jnp.int32)),
+        removed=s.removed.at[-1].set(False),
+    )
+    keys, vals = _resort(new)
+    return ORSet(elem=keys[0], rid=keys[1], seq=keys[2], removed=vals)
+
+
+@jax.jit
+def remove(s: ORSet, elem) -> ORSet:
+    """Tombstone every currently-observed tag of `elem`."""
+    hit = (s.elem == jnp.asarray(elem, jnp.int32)) & (s.elem != SENTINEL)
+    return s.replace(removed=s.removed | hit)
+
+
+def join(a: ORSet, b: ORSet) -> ORSet:
+    out, _ = join_checked(a, b)
+    return out
+
+
+@jax.jit
+def join_checked(a: ORSet, b: ORSet):
+    """Join returning (set, n_unique) so callers can detect capacity
+    overflow (n_unique > capacity ⇒ tags were dropped)."""
+    keys, removed, n_unique = su.sorted_union(
+        (a.elem, a.rid, a.seq),
+        a.removed,
+        (b.elem, b.rid, b.seq),
+        b.removed,
+        combine=lambda x, y: x | y,
+        out_size=a.capacity,
+    )
+    return ORSet(elem=keys[0], rid=keys[1], seq=keys[2], removed=removed), n_unique
+
+
+def contains(s: ORSet, elem) -> jax.Array:
+    hit = (s.elem == jnp.asarray(elem, jnp.int32)) & (s.elem != SENTINEL)
+    return jnp.any(hit & ~s.removed)
+
+
+@partial(jax.jit, static_argnames="n_universe")
+def member_mask(s: ORSet, n_universe: int) -> jax.Array:
+    """bool[n_universe]: which element ids are present (≥1 live tag)."""
+    valid = s.elem != SENTINEL
+    idx = jnp.where(valid, s.elem, n_universe)
+    mask = jnp.zeros((n_universe + 1,), bool).at[idx].max(valid & ~s.removed)
+    return mask[:n_universe]
+
+
+def _resort(s: ORSet):
+    out = jax.lax.sort([s.elem, s.rid, s.seq, s.removed], num_keys=3, is_stable=True)
+    return out[:3], out[3]

@@ -1,0 +1,52 @@
+"""PN-Counter: increment/decrement counter lattice, array-encoded for TPU.
+
+This is the lattice the reference actually implements per key: integer deltas
+of either sign accumulate by addition (/root/reference/main.go:195-206, and the
+workload generator only ever produces negative deltas, main.go:275-282).
+
+Encoding
+--------
+Two G-Counter planes, ``pos`` and ``neg``: int32[..., n_nodes].  Increments go
+to ``pos[node]``, decrements add ``|amount|`` to ``neg[node]``.  join =
+elementwise max of both planes; value = sum(pos) - sum(neg).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class PNCounter:
+    pos: jax.Array  # int32[..., n_nodes]
+    neg: jax.Array  # int32[..., n_nodes]
+
+    @property
+    def n_nodes(self) -> int:
+        return self.pos.shape[-1]
+
+
+def zero(n_nodes: int, batch: tuple = (), dtype=jnp.int32) -> PNCounter:
+    z = jnp.zeros((*batch, n_nodes), dtype)
+    return PNCounter(pos=z, neg=z)
+
+
+def add(c: PNCounter, node, amount) -> PNCounter:
+    """Local op: node applies a signed integer delta (reference write
+    semantics, main.go:195-206)."""
+    amount = jnp.asarray(amount, c.pos.dtype)
+    pos_delta = jnp.maximum(amount, 0)
+    neg_delta = jnp.maximum(-amount, 0)
+    return PNCounter(
+        pos=c.pos.at[..., node].add(pos_delta),
+        neg=c.neg.at[..., node].add(neg_delta),
+    )
+
+
+def join(a: PNCounter, b: PNCounter) -> PNCounter:
+    return PNCounter(pos=jnp.maximum(a.pos, b.pos), neg=jnp.maximum(a.neg, b.neg))
+
+
+def value(c: PNCounter) -> jax.Array:
+    return c.pos.sum(axis=-1) - c.neg.sum(axis=-1)

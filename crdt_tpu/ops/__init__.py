@@ -1,0 +1,1 @@
+from crdt_tpu.ops import joins, sorted_union  # noqa: F401

@@ -1,0 +1,72 @@
+"""Join combinators: batched and tree-reduced lattice joins.
+
+The reference converges a swarm by many pairwise gossip merges
+(/root/reference/main.go:226-261).  On TPU the same capability has two gears:
+
+* ``batched(join)`` — vmap a pairwise join over the replica axis: one call
+  performs R independent merges (the BASELINE "1K-replica vmap" config).
+* ``tree_reduce_join`` — log-depth pairwise reduction of a whole stacked swarm
+  to the least upper bound of every replica's state: one jitted call ≡ the
+  fixpoint of infinitely many gossip rounds ("one pod step converges millions
+  of replicas at once", BASELINE.json north star).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def batched(join_fn: Callable) -> Callable:
+    """Vmap a single-instance pairwise join over a leading replica axis."""
+    return jax.vmap(join_fn)
+
+
+def _leading_dim(state: Any) -> int:
+    return jax.tree.leaves(state)[0].shape[0]
+
+
+def pad_to_pow2(state: Any, neutral: Any) -> Any:
+    """Pad the leading replica axis up to a power of two with copies of the
+    join identity element `neutral` (a single-instance state)."""
+    r = _leading_dim(state)
+    p = 1
+    while p < r:
+        p *= 2
+    if p == r:
+        return state
+    return jax.tree.map(
+        lambda x, n: jnp.concatenate(
+            [x, jnp.broadcast_to(n[None], (p - r,) + n.shape)], axis=0
+        ),
+        state,
+        neutral,
+    )
+
+
+def tree_reduce_join(join_fn: Callable, state: Any, neutral: Any) -> Any:
+    """Reduce a stacked swarm state (leading axis = replicas) to the join of
+    all replicas, in log2(R) batched join steps.
+
+    `join_fn` must accept batched states (use `batched(...)` for joins written
+    single-instance).  `neutral` is the single-instance identity element used
+    to pad R up to a power of two (every model module exports a suitable
+    ``zero``/``empty``).
+    """
+    state = pad_to_pow2(state, neutral)
+    p = _leading_dim(state)
+    while p > 1:
+        p //= 2
+        lo = jax.tree.map(lambda x: x[:p], state)
+        hi = jax.tree.map(lambda x: x[p : 2 * p], state)
+        state = join_fn(lo, hi)
+    return jax.tree.map(lambda x: x[0], state)
+
+
+def converge(join_fn: Callable, state: Any, neutral: Any) -> Any:
+    """Drive every replica to the swarm-wide least upper bound: the TPU-native
+    equivalent of running the reference's gossip loop to its fixpoint."""
+    r = _leading_dim(state)
+    top = tree_reduce_join(join_fn, state, neutral)
+    return jax.tree.map(lambda t: jnp.broadcast_to(t[None], (r,) + t.shape), top)

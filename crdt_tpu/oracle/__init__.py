@@ -1,0 +1,1 @@
+from crdt_tpu.oracle.replica import OracleReplica, Quirks  # noqa: F401

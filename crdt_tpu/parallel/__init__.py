@@ -1,0 +1,1 @@
+from crdt_tpu.parallel import mesh, swarm  # noqa: F401

@@ -1,0 +1,134 @@
+"""Anti-entropy swarm engine: N replicas as array rows on one chip/mesh.
+
+The reference runs 5 replicas in one OS process, each pulling a random peer's
+full state every 1500 ms and merging (/root/reference/main.go:226-261,
+316-323).  Here a swarm is a *stacked lattice state* (leading axis =
+replicas); one gossip round is a gather + batched join, and full convergence
+is a log-depth tree reduction — so "infinitely many gossip rounds" collapse
+into one jitted call.
+
+Fault model (reference parity): an ``alive`` mask gates participation — a
+dead replica neither serves gossip (main.go:166-169: /gossip returns 502 and
+the puller skips, main.go:239) nor pulls; a revived replica catches up in one
+round because gossip always ships full state (main.go:159).  This mask is the
+*fixed* version of the reference's broken /condition endpoint (§0.1.7).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from crdt_tpu.ops import joins
+
+
+@struct.dataclass
+class Swarm:
+    state: Any        # pytree; every leaf has leading axis R (replicas)
+    alive: jax.Array  # bool[R]
+
+
+def make(state: Any, alive: jax.Array | None = None) -> Swarm:
+    r = jax.tree.leaves(state)[0].shape[0]
+    if alive is None:
+        alive = jnp.ones((r,), bool)
+    return Swarm(state=state, alive=alive)
+
+
+def n_replicas(s: Swarm) -> int:
+    return s.alive.shape[0]
+
+
+def set_alive(s: Swarm, rid, alive_status) -> Swarm:
+    """Failure injection / recovery — the reference's /condition capability
+    (main.go:141-152), with the routing bug fixed."""
+    return s.replace(alive=s.alive.at[rid].set(alive_status))
+
+
+def random_peers(key: jax.Array, r: int, include_self: bool = False) -> jax.Array:
+    """Uniform random peer choice per replica (main.go:230 picks uniformly
+    from the friend list, which includes self — self-gossip is a harmless
+    no-op join, so include_self=True is reference-faithful).  With
+    include_self=False the draw is uniform over the r-1 non-self peers
+    (a random offset in [1, r) from the replica's own index)."""
+    if include_self:
+        return jax.random.randint(key, (r,), 0, r)
+    offsets = jax.random.randint(key, (r,), 1, r)
+    return (jnp.arange(r) + offsets) % r
+
+
+def _alive_mask(alive: jax.Array, leaf: jax.Array) -> jax.Array:
+    return alive.reshape((-1,) + (1,) * (leaf.ndim - 1))
+
+
+def mask_dead_with_neutral(state: Any, alive: jax.Array, neutral: Any) -> Any:
+    """Replace dead replicas' rows with the join identity so they contribute
+    nothing to a reduction (the 502-skip of an unreachable peer)."""
+    return jax.tree.map(
+        lambda x, n: jnp.where(
+            _alive_mask(alive, x), x, jnp.broadcast_to(n[None], x.shape)
+        ),
+        state,
+        neutral,
+    )
+
+
+def alive_lub(state: Any, alive: jax.Array, join_batched: Callable, neutral: Any) -> Any:
+    """Least upper bound of the alive replicas' states (single-instance)."""
+    masked = mask_dead_with_neutral(state, alive, neutral)
+    return joins.tree_reduce_join(join_batched, masked, neutral)
+
+
+def broadcast_where_alive(state: Any, alive: jax.Array, top: Any) -> Any:
+    """Set every alive replica's row to `top`; dead rows keep their state."""
+    return jax.tree.map(
+        lambda t, x: jnp.where(
+            _alive_mask(alive, x), jnp.broadcast_to(t[None], x.shape), x
+        ),
+        top,
+        state,
+    )
+
+
+def gossip_round(s: Swarm, peers: jax.Array, join_batched: Callable) -> Swarm:
+    """One pull round: replica i fetches peers[i]'s full state and joins it.
+
+    `join_batched` joins two stacked states ([R, ...] x [R, ...] -> [R, ...]);
+    use crdt_tpu.ops.joins.batched(join) for single-instance joins.  Joins are
+    gated on both endpoints being alive (dead peer -> skipped pull; dead
+    puller -> no merge), matching the reference's 502-skip path.
+    """
+    peer_state = jax.tree.map(lambda x: x[peers], s.state)
+    joined = join_batched(s.state, peer_state)
+    ok = s.alive & s.alive[peers]
+    state = jax.tree.map(
+        lambda j, x: jnp.where(ok.reshape((-1,) + (1,) * (j.ndim - 1)), j, x),
+        joined,
+        s.state,
+    )
+    return s.replace(state=state)
+
+
+def converge(s: Swarm, join_batched: Callable, neutral: Any) -> Swarm:
+    """Drive all *alive* replicas to the least upper bound of alive states in
+    one call (the gossip fixpoint).  Dead replicas contribute nothing and
+    keep their stale state, exactly as an unreachable reference replica
+    would; `neutral` is the single-instance join identity."""
+    top = alive_lub(s.state, s.alive, join_batched, neutral)
+    return s.replace(state=broadcast_where_alive(s.state, s.alive, top))
+
+
+def n_diverged(s: Swarm, join_batched: Callable, neutral: Any) -> jax.Array:
+    """Convergence-lag metric: how many alive replicas are NOT yet at the
+    swarm-wide least upper bound (0 = converged)."""
+    top = alive_lub(s.state, s.alive, join_batched, neutral)
+
+    def leaf_eq(x, t):
+        eq = x == jnp.broadcast_to(t[None], x.shape)
+        return eq.reshape(eq.shape[0], -1).all(axis=1)
+
+    eqs = jax.tree.map(leaf_eq, s.state, top)
+    all_eq = jnp.stack(jax.tree.leaves(eqs), axis=0).all(axis=0)
+    return jnp.sum(s.alive & ~all_eq).astype(jnp.int32)

@@ -1,0 +1,1 @@
+from crdt_tpu.utils import clock, constants, intern  # noqa: F401

@@ -1,0 +1,49 @@
+"""Host-side time & sequence sources.
+
+Timestamps must be *inputs* to jitted code, never computed on-device
+(SURVEY.md §7 hard part (d)).  The reference keys its op log by
+`time.Now().UnixMilli()` (/root/reference/main.go:187) — an int64 and a
+collision source (§0.1.2).  Here: int32 millisecond offsets from a per-run
+epoch (≈24 days of range) plus a per-replica monotone sequence number, so op
+identity (ts, rid, seq) is unique at any rate.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+
+
+class HostClock:
+    """Millisecond clock relative to a fixed epoch (defaults to creation)."""
+
+    def __init__(self, epoch_ms: int | None = None):
+        self.epoch_ms = int(time.time() * 1000) if epoch_ms is None else epoch_ms
+
+    def now_ms(self) -> int:
+        """int32-ranged ms offset from the epoch, clamped non-negative."""
+        return max(0, int(time.time() * 1000) - self.epoch_ms)
+
+
+class ManualClock(HostClock):
+    """Deterministic clock for tests/oracles: advances only when told."""
+
+    def __init__(self, start: int = 0):
+        super().__init__(epoch_ms=0)
+        self._now = start
+
+    def now_ms(self) -> int:
+        return self._now
+
+    def advance(self, ms: int = 1) -> int:
+        self._now += ms
+        return self._now
+
+
+class SeqGen:
+    """Per-replica monotone sequence numbers (op identity tiebreak)."""
+
+    def __init__(self, start: int = 0):
+        self._it = itertools.count(start)
+
+    def next(self) -> int:
+        return next(self._it)

@@ -1,0 +1,101 @@
+"""Shared test helpers: random lattice states and pytree equality."""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from crdt_tpu.models import gcounter, lww, oplog, orset, pncounter
+
+
+def tree_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def rand_gcounter(rng: np.random.Generator, n_nodes=8, batch=()):
+    return gcounter.GCounter(
+        counts=np.asarray(rng.integers(0, 100, (*batch, n_nodes)), np.int32)
+    )
+
+
+def rand_pncounter(rng: np.random.Generator, n_nodes=8, batch=()):
+    return pncounter.PNCounter(
+        pos=np.asarray(rng.integers(0, 100, (*batch, n_nodes)), np.int32),
+        neg=np.asarray(rng.integers(0, 100, (*batch, n_nodes)), np.int32),
+    )
+
+
+def rand_lww(rng: np.random.Generator, batch=()):
+    return lww.LWWRegister(
+        ts=np.asarray(rng.integers(-1, 50, batch), np.int32),
+        rid=np.asarray(rng.integers(0, 8, batch), np.int32),
+        payload=np.asarray(rng.integers(0, 1000, batch), np.int32),
+    )
+
+
+def rand_orset(rng: np.random.Generator, capacity=32, n_elems=6, n_rids=3, fill=10):
+    """Random OR-Set with `fill` unique tags (≤ capacity/3 so pairwise and
+    three-way joins stay within capacity for law tests)."""
+    s = orset.empty(capacity)
+    taken = set()
+    for _ in range(fill):
+        while True:
+            tag = (
+                int(rng.integers(0, n_elems)),
+                int(rng.integers(0, n_rids)),
+                int(rng.integers(0, 50)),
+            )
+            if tag not in taken:
+                taken.add(tag)
+                break
+        s = orset.add(s, *tag)
+        if rng.random() < 0.3:
+            s = orset.remove(s, tag[0])
+    return s
+
+
+def rand_ops(rng: np.random.Generator, n, n_keys=6, n_rids=3, numeric_frac=0.8):
+    """Random op columns with unique (ts, rid, seq, key) rows."""
+    rows = set()
+    while len(rows) < n:
+        rows.add(
+            (
+                int(rng.integers(0, 40)),
+                int(rng.integers(0, n_rids)),
+                int(rng.integers(0, 20)),
+                int(rng.integers(0, n_keys)),
+            )
+        )
+    rows = sorted(rows)
+    is_num = rng.random(n) < numeric_frac
+    val = np.where(
+        is_num,
+        rng.integers(-20, 21, n),
+        rng.integers(0, 50, n),
+    )
+    return {
+        "ts": np.asarray([r[0] for r in rows], np.int32),
+        "rid": np.asarray([r[1] for r in rows], np.int32),
+        "seq": np.asarray([r[2] for r in rows], np.int32),
+        "key": np.asarray([r[3] for r in rows], np.int32),
+        "val": np.asarray(val, np.int32),
+        "payload": np.asarray(rng.integers(0, 100, n), np.int32),
+        "is_num": np.asarray(is_num, bool),
+    }
+
+
+def rand_oplog(rng: np.random.Generator, capacity=64, n=12, **kw):
+    return oplog.from_ops(capacity, rand_ops(rng, n, **kw))
+
+
+def rand_oplog_family(rng: np.random.Generator, n_logs=3, capacity=64, pool=20, take=12, **kw):
+    """Logs sampling from one shared op pool: identical (ts,rid,seq,key) rows
+    carry identical payloads, as real replicated ops do."""
+    ops = rand_ops(rng, pool, **kw)
+    logs = []
+    for _ in range(n_logs):
+        idx = np.sort(rng.choice(pool, size=take, replace=False))
+        logs.append(oplog.from_ops(capacity, {k: v[idx] for k, v in ops.items()}))
+    return logs

@@ -1,0 +1,117 @@
+"""Property tests of the join laws every CRDT lattice must satisfy:
+commutativity, associativity, idempotence, and identity (SURVEY.md §4's
+mandate — the reference has no tests at all; convergence there was eyeballed
+via GET /data polling, /root/reference/main.go:273-314)."""
+import zlib
+
+import numpy as np
+import pytest
+
+from crdt_tpu.models import gcounter, lww, oplog, orset, pncounter
+from tests import helpers
+from tests.helpers import tree_equal
+
+N_TRIALS = 20
+
+
+def _cases():
+    return [
+        (
+            "gcounter",
+            gcounter.join,
+            lambda rng: helpers.rand_gcounter(rng),
+            lambda: gcounter.zero(8),
+        ),
+        (
+            "pncounter",
+            pncounter.join,
+            lambda rng: helpers.rand_pncounter(rng),
+            lambda: pncounter.zero(8),
+        ),
+        (
+            "lww",
+            lww.join,
+            lambda rng: helpers.rand_lww(rng),
+            lambda: lww.zero(),
+        ),
+        (
+            "orset",
+            orset.join,
+            lambda rng: helpers.rand_orset(rng),
+            lambda: orset.empty(32),
+        ),
+    ]
+
+
+@pytest.mark.parametrize("name,join,gen,zero", _cases(), ids=lambda c: c if isinstance(c, str) else "")
+def test_join_laws(name, join, gen, zero):
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    for _ in range(N_TRIALS):
+        a, b, c = gen(rng), gen(rng), gen(rng)
+        assert tree_equal(join(a, b), join(b, a)), "commutativity"
+        assert tree_equal(join(join(a, b), c), join(a, join(b, c))), "associativity"
+        assert tree_equal(join(a, a), a), "idempotence"
+        assert tree_equal(join(a, zero()), a), "identity"
+
+
+def test_oplog_join_laws():
+    rng = np.random.default_rng(7)
+    for _ in range(N_TRIALS):
+        a, b, c = helpers.rand_oplog_family(rng, n_logs=3)
+        j = oplog.merge
+        assert tree_equal(j(a, b), j(b, a)), "commutativity"
+        assert tree_equal(j(j(a, b), c), j(a, j(b, c))), "associativity"
+        assert tree_equal(j(a, a), a), "idempotence"
+        assert tree_equal(j(a, oplog.empty(a.capacity)), a), "identity"
+
+
+def test_gcounter_value_and_increment():
+    c = gcounter.zero(4)
+    c = gcounter.increment(c, 1, 5)
+    c = gcounter.increment(c, 3, 2)
+    assert int(gcounter.value(c)) == 7
+
+
+def test_pncounter_signed_deltas():
+    # The reference workload only produces negative deltas (main.go:275-282);
+    # make sure the negative plane carries them.
+    c = pncounter.zero(4)
+    for node, delta in [(0, -11), (1, -20), (0, 4)]:
+        c = pncounter.add(c, node, delta)
+    assert int(pncounter.value(c)) == -27
+
+
+def test_lww_resolution_is_order_free():
+    rng = np.random.default_rng(3)
+    writes = [
+        (int(rng.integers(0, 100)), int(rng.integers(0, 8)), i)
+        for i in range(10)
+    ]
+    expected = max(writes)[2]
+    reg = lww.zero()
+    for ts, rid, payload in reversed(writes):
+        reg = lww.write(reg, ts, rid, payload)
+    assert int(lww.value(reg)) == expected
+
+
+def test_orset_add_remove_readd():
+    s = orset.empty(16)
+    s = orset.add(s, elem=3, rid=0, seq=0)
+    assert bool(orset.contains(s, 3))
+    s = orset.remove(s, 3)
+    assert not bool(orset.contains(s, 3))
+    s = orset.add(s, elem=3, rid=1, seq=0)  # re-add with a fresh tag survives
+    assert bool(orset.contains(s, 3))
+
+
+def test_orset_observed_remove_concurrent_add_wins():
+    # replica A adds, B observes and removes, meanwhile A adds again with a
+    # new tag: the re-add must survive the join with B's tombstones.
+    a = orset.empty(16)
+    a = orset.add(a, elem=1, rid=0, seq=0)
+    b = orset.join(orset.empty(16), a)  # B observes
+    b = orset.remove(b, 1)
+    a = orset.add(a, elem=1, rid=0, seq=1)  # concurrent re-add
+    merged = orset.join(a, b)
+    assert bool(orset.contains(merged, 1))
+    assert list(np.asarray(orset.member_mask(merged, 4))) == [False, True, False, False]

@@ -1,0 +1,101 @@
+"""OpLog store unit tests: append/merge/rebuild semantics (the reference's
+write path main.go:173-215, merge main.go:35-100).  Bit-exact parity against
+the quirk-togglable oracle lives in tests/test_parity.py."""
+import numpy as np
+
+from crdt_tpu.models import oplog
+from tests import helpers
+from tests.helpers import tree_equal
+
+
+def _ops(rows):
+    """rows: list of (ts, rid, seq, key, val, is_num); payload mirrors val."""
+    cols = list(zip(*rows))
+    names = ["ts", "rid", "seq", "key", "val", "is_num"]
+    d = {
+        n: np.asarray(c, bool if n == "is_num" else np.int32)
+        for n, c in zip(names, cols)
+    }
+    d["payload"] = d["val"].copy()
+    return d
+
+
+def test_append_and_rebuild_counter():
+    log = oplog.empty(16)
+    log = oplog.append_batch(
+        log, _ops([(1, 0, 0, 0, 5, True), (2, 0, 1, 0, -3, True), (3, 1, 0, 1, 7, True)])
+    )
+    kv = oplog.rebuild(log, n_keys=3)
+    assert list(np.asarray(kv.present)) == [True, True, False]
+    assert list(np.asarray(kv.num)) == [2, 7, 0]
+    assert int(oplog.size(log)) == 3
+
+
+def test_rebuild_lww_for_non_numeric_newest():
+    # newest entry for key 0 is non-numeric -> LWW payload; older numeric
+    # deltas are skipped (reference fold: curr fails Atoi, main.go:87-90).
+    log = oplog.empty(8)
+    log = oplog.append_batch(
+        log, _ops([(1, 0, 0, 0, 5, True), (9, 1, 0, 0, 42, False)])
+    )
+    kv = oplog.rebuild(log, n_keys=1)
+    assert not bool(kv.is_num[0])
+    assert int(kv.payload[0]) == 42
+
+
+def test_rebuild_numeric_newest_sums_all_numeric():
+    # newest numeric -> counter mode: sum of ALL numeric entries, non-numeric
+    # interlopers skipped (main.go:91-96).
+    log = oplog.empty(8)
+    log = oplog.append_batch(
+        log,
+        _ops([(1, 0, 0, 0, 5, True), (2, 0, 1, 0, 99, False), (3, 0, 2, 0, -2, True)]),
+    )
+    kv = oplog.rebuild(log, n_keys=1)
+    assert bool(kv.is_num[0])
+    assert int(kv.num[0]) == 3
+
+
+def test_merge_adopts_all_remote_no_tail_drop():
+    # Remote ops newer than everything local are adopted in ONE merge —
+    # the fix for quirk §0.1.3 (reference loop ends at the shorter log).
+    local = oplog.from_ops(16, _ops([(1, 0, 0, 0, 1, True)]))
+    remote = oplog.from_ops(16, _ops([(10, 1, 0, 0, 2, True), (20, 1, 1, 0, 3, True)]))
+    merged = oplog.merge(local, remote)
+    assert int(oplog.size(merged)) == 3
+    assert int(oplog.rebuild(merged, 1).num[0]) == 6
+
+
+def test_same_millisecond_ops_do_not_collide():
+    # Two ops in the same ms from different writers both survive — the fix
+    # for quirk §0.1.2 (reference keys the log by UnixMilli alone).
+    a = oplog.from_ops(16, _ops([(5, 0, 0, 0, 1, True)]))
+    b = oplog.from_ops(16, _ops([(5, 1, 0, 0, 10, True)]))
+    merged = oplog.merge(a, b)
+    assert int(oplog.size(merged)) == 2
+    assert int(oplog.rebuild(merged, 1).num[0]) == 11
+
+
+def test_multi_key_command_applies_fully():
+    # A multi-key command is several rows sharing (ts, rid, seq) — all keys
+    # apply (fix for quirk §0.1.4's early return).
+    log = oplog.from_ops(
+        16, _ops([(1, 0, 0, 0, 4, True), (1, 0, 0, 1, 6, True), (1, 0, 0, 2, 8, True)])
+    )
+    kv = oplog.rebuild(log, n_keys=3)
+    assert list(np.asarray(kv.num)) == [4, 6, 8]
+
+
+def test_merge_convergence_random():
+    rng = np.random.default_rng(11)
+    for _ in range(10):
+        logs = helpers.rand_oplog_family(rng, n_logs=4, capacity=64, pool=24, take=12)
+        # all-pairs gossip in two different orders reaches the same state
+        x = logs[0]
+        for l in logs[1:]:
+            x = oplog.merge(x, l)
+        y = logs[-1]
+        for l in reversed(logs[:-1]):
+            y = oplog.merge(y, l)
+        assert tree_equal(x, y)
+        assert tree_equal(oplog.rebuild(x, 6), oplog.rebuild(y, 6))

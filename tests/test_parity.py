@@ -1,0 +1,132 @@
+"""Bit-exact parity: the TPU OpLog path vs the pure-Python oracle.
+
+Strategy (SURVEY.md §4): random workloads of reference-shaped commands
+(single-key string deltas, occasional non-numeric values, multi-key commands)
+are applied to both an oracle swarm (quirks OFF = fixed semantics) and the
+array-encoded OpLog replicas; after every merge schedule the materialized
+views must match string-for-string."""
+import numpy as np
+import pytest
+
+from crdt_tpu.models import oplog
+from crdt_tpu.oracle import OracleReplica, Quirks
+from crdt_tpu.utils.intern import Interner, encode_value, parse_go_int
+
+ALPHABET = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ1234567890"
+
+
+class DeviceReplica:
+    """Thin host wrapper pairing an OpLog with the interners, mirroring the
+    oracle's add_command/gossip/receive surface for the tests."""
+
+    def __init__(self, rid: int, capacity: int, keys: Interner, values: Interner):
+        self.rid = rid
+        self.keys = keys
+        self.values = values
+        self.log = oplog.empty(capacity)
+        self._seq = 0
+
+    def add_command(self, cmd: dict, ts: int) -> None:
+        seq = self._seq
+        self._seq += 1
+        rows = {"ts": [], "rid": [], "seq": [], "key": [], "val": [], "payload": [], "is_num": []}
+        for k, v in cmd.items():
+            val, payload, is_num = encode_value(v, self.values)
+            rows["ts"].append(ts)
+            rows["rid"].append(self.rid)
+            rows["seq"].append(seq)
+            rows["key"].append(self.keys.intern(k))
+            rows["val"].append(val)
+            rows["payload"].append(payload)
+            rows["is_num"].append(is_num)
+        ops = {
+            n: np.asarray(c, bool if n == "is_num" else np.int32)
+            for n, c in rows.items()
+        }
+        self.log = oplog.append_batch(self.log, ops, batch_capacity=len(cmd))
+
+    def receive(self, remote_log: oplog.OpLog) -> None:
+        self.log = oplog.merge(self.log, remote_log)
+
+    def materialized(self) -> dict:
+        """Decode KVState back to the reference's {key: string} map."""
+        kv = oplog.rebuild(self.log, n_keys=len(self.keys))
+        return oplog.materialize(kv, self.keys, self.values)
+
+
+def _rand_cmd(rng, multi_key_p=0.2, non_num_p=0.15, odd_num_p=0.1):
+    n_keys = 1 + int(rng.random() < multi_key_p)
+    cmd = {}
+    while len(cmd) < n_keys:
+        k = ALPHABET[rng.integers(0, len(ALPHABET))]
+        u = rng.random()
+        if u < non_num_p:
+            cmd[k] = "s" + str(int(rng.integers(0, 100)))  # non-numeric value
+        elif u < non_num_p + odd_num_p:
+            # numeric strings Atoi accepts but Itoa would not emit — these
+            # must survive verbatim while they are a key's only numeric op
+            cmd[k] = rng.choice(["007", "+7", "-0", "+0", "000"])
+        else:
+            # reference workload delta distribution (main.go:275-282)
+            cmd[k] = str(int(rng.integers(0, 10)) - 20)
+    return cmd
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_device_matches_oracle_random_workload(seed):
+    rng = np.random.default_rng(seed)
+    n_replicas, n_writes, capacity = 4, 40, 128
+    keys, values = Interner(), Interner()
+    dev = [DeviceReplica(r, capacity, keys, values) for r in range(n_replicas)]
+    ora = [OracleReplica(r, Quirks()) for r in range(n_replicas)]
+
+    ts = 0
+    for w in range(n_writes):
+        ts += int(rng.integers(0, 3))  # deliberately allow same-ms collisions
+        r = int(rng.integers(0, n_replicas))
+        cmd = _rand_cmd(rng)
+        dev[r].add_command(cmd, ts)
+        ora[r].add_command(cmd, ts)
+
+        if w % 5 == 4:  # a gossip pull: random (dst, src) pair
+            dst, src = rng.choice(n_replicas, size=2, replace=False)
+            dev[dst].receive(dev[src].log)
+            ora[dst].receive(ora[src].gossip_payload())
+
+    for r in range(n_replicas):
+        assert dev[r].materialized() == ora[r].rebuilt_state(), f"replica {r}"
+
+
+def test_full_convergence_matches_oracle():
+    rng = np.random.default_rng(42)
+    n_replicas, capacity = 3, 64
+    keys, values = Interner(), Interner()
+    dev = [DeviceReplica(r, capacity, keys, values) for r in range(n_replicas)]
+    ora = [OracleReplica(r, Quirks()) for r in range(n_replicas)]
+    for w in range(20):
+        r = int(rng.integers(0, n_replicas))
+        cmd = _rand_cmd(rng)
+        dev[r].add_command(cmd, ts=w)
+        ora[r].add_command(cmd, ts=w)
+
+    # all-pairs gossip twice = guaranteed fixpoint for 3 replicas
+    for _ in range(2):
+        for dst in range(n_replicas):
+            for src in range(n_replicas):
+                if dst != src:
+                    dev[dst].receive(dev[src].log)
+                    ora[dst].receive(ora[src].gossip_payload())
+
+    expect = OracleReplica.converged_state(ora)
+    for r in range(n_replicas):
+        assert dev[r].materialized() == expect
+        assert ora[r].rebuilt_state() == expect
+
+
+def test_parse_go_int_matches_go_atoi():
+    assert parse_go_int("42") == 42
+    assert parse_go_int("-13") == -13
+    assert parse_go_int("+7") == 7
+    assert parse_go_int("007") == 7
+    for bad in ["", " 1", "1 ", "1_0", "0x10", "1.5", "abc", "--1", "+", "٣"]:
+        assert parse_go_int(bad) is None, bad

@@ -1,0 +1,83 @@
+"""Unit tests for the sorted-segment union op (the TPU replacement for the
+reference's two-pointer log union, /root/reference/main.go:49-73)."""
+import jax.numpy as jnp
+import numpy as np
+
+from crdt_tpu.ops import sorted_union as su
+from crdt_tpu.utils.constants import SENTINEL_PY
+
+
+def _mk(keys, vals, cap):
+    """Build sorted sentinel-padded single-column keyed arrays."""
+    n = len(keys)
+    pad = cap - n
+    k = np.asarray(sorted(keys) + [SENTINEL_PY] * pad, np.int32)
+    order = np.argsort(keys, kind="stable")
+    v = np.asarray([vals[i] for i in order] + [0] * pad, np.int32)
+    return (jnp.asarray(k),), jnp.asarray(v)
+
+
+def test_disjoint_union():
+    ka, va = _mk([1, 5], [10, 50], 4)
+    kb, vb = _mk([2, 9], [20, 90], 4)
+    keys, vals, n = su.sorted_union(ka, va, kb, vb, out_size=8)
+    assert int(n) == 4
+    assert list(np.asarray(keys[0])[:4]) == [1, 2, 5, 9]
+    assert list(np.asarray(vals)[:4]) == [10, 20, 50, 90]
+    assert all(np.asarray(keys[0])[4:] == SENTINEL_PY)
+
+
+def test_duplicate_keeps_first_side():
+    ka, va = _mk([3, 7], [1, 2], 4)
+    kb, vb = _mk([3, 8], [99, 3], 4)
+    keys, vals, n = su.sorted_union(ka, va, kb, vb, out_size=8)
+    assert int(n) == 3
+    assert list(np.asarray(keys[0])[:3]) == [3, 7, 8]
+    # local (a-side) value wins on the duplicate key 3
+    assert list(np.asarray(vals)[:3]) == [1, 2, 3]
+
+
+def test_duplicate_custom_combine():
+    ka, va = _mk([3], [4], 2)
+    kb, vb = _mk([3], [8], 2)
+    _, vals, n = su.sorted_union(ka, va, kb, vb, combine=lambda x, y: x | y, out_size=4)
+    assert int(n) == 1
+    assert int(np.asarray(vals)[0]) == 12
+
+
+def test_multicolumn_keys_tie_on_first():
+    # same first column, distinct second column -> two distinct keys
+    ka = (jnp.asarray([5, SENTINEL_PY], jnp.int32), jnp.asarray([0, SENTINEL_PY], jnp.int32))
+    kb = (jnp.asarray([5, SENTINEL_PY], jnp.int32), jnp.asarray([1, SENTINEL_PY], jnp.int32))
+    va = jnp.asarray([10, 0], jnp.int32)
+    vb = jnp.asarray([11, 0], jnp.int32)
+    keys, vals, n = su.sorted_union(ka, va, kb, vb)
+    assert int(n) == 2
+    assert list(np.asarray(keys[0])[:2]) == [5, 5]
+    assert list(np.asarray(keys[1])[:2]) == [0, 1]
+    assert list(np.asarray(vals)[:2]) == [10, 11]
+
+
+def test_out_size_truncates_largest():
+    ka, va = _mk([1, 2], [1, 2], 2)
+    kb, vb = _mk([3, 4], [3, 4], 2)
+    keys, vals, n = su.sorted_union(ka, va, kb, vb, out_size=3)
+    assert int(n) == 4  # true union size still reported
+    assert list(np.asarray(keys[0])) == [1, 2, 3]
+
+
+def test_against_python_set_semantics():
+    rng = np.random.default_rng(0)
+    for _ in range(25):
+        cap = 16
+        a = {int(k): int(v) for k, v in zip(rng.choice(100, 8, replace=False), rng.integers(0, 50, 8))}
+        b = {int(k): int(v) for k, v in zip(rng.choice(100, 8, replace=False), rng.integers(0, 50, 8))}
+        ka, va = _mk(list(a), [a[k] for k in a], cap)
+        kb, vb = _mk(list(b), [b[k] for k in b], cap)
+        keys, vals, n = su.sorted_union(ka, va, kb, vb)
+        expect = dict(b)
+        expect.update(a)  # a wins duplicates
+        got_keys = [int(k) for k in np.asarray(keys[0]) if k != SENTINEL_PY]
+        got = {k: int(v) for k, v in zip(got_keys, np.asarray(vals))}
+        assert int(n) == len(expect)
+        assert got == expect

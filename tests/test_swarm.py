@@ -1,0 +1,107 @@
+"""Swarm anti-entropy tests: random-peer gossip convergence, fault
+injection, and one-shot convergence — the automated version of the
+reference's eyeball-a-soak-run validation (SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crdt_tpu.models import gcounter, oplog, pncounter
+from crdt_tpu.ops import joins
+from crdt_tpu.parallel import swarm
+from tests import helpers
+from tests.helpers import tree_equal
+
+
+def _counter_swarm(rng, r=16, n_nodes=8):
+    # replica i starts knowing only its own increments (diagonal writes)
+    counts = np.zeros((r, n_nodes), np.int32)
+    for i in range(r):
+        counts[i, i % n_nodes] = rng.integers(1, 50)
+    return swarm.make(gcounter.GCounter(counts=jnp.asarray(counts)))
+
+
+def test_gossip_rounds_converge_counter():
+    rng = np.random.default_rng(0)
+    s = _counter_swarm(rng)
+    key = jax.random.key(0)
+    join_b = gcounter.join  # elementwise ops broadcast over the replica axis
+    neutral = gcounter.zero(8)
+    for i in range(20):
+        key, k = jax.random.split(key)
+        peers = swarm.random_peers(k, swarm.n_replicas(s))
+        s = swarm.gossip_round(s, peers, join_b)
+        if int(swarm.n_diverged(s, join_b, neutral)) == 0:
+            break
+    assert int(swarm.n_diverged(s, join_b, neutral)) == 0
+    # every replica's value equals the total of all writes
+    vals = np.asarray(gcounter.value(s.state))
+    assert (vals == vals[0]).all()
+
+
+def test_one_shot_converge_equals_gossip_fixpoint():
+    rng = np.random.default_rng(1)
+    s = _counter_swarm(rng)
+    neutral = gcounter.zero(8)
+    s2 = swarm.converge(s, gcounter.join, neutral)
+    assert int(swarm.n_diverged(s2, gcounter.join, neutral)) == 0
+    total = np.asarray(s.state.counts).max(axis=0).sum()
+    assert (np.asarray(gcounter.value(s2.state)) == total).all()
+
+
+def test_dead_replica_excluded_then_catches_up():
+    rng = np.random.default_rng(2)
+    s = _counter_swarm(rng, r=8)
+    neutral = gcounter.zero(8)
+    dead = 3
+    s = swarm.set_alive(s, dead, False)
+    before = np.asarray(s.state.counts[dead]).copy()
+
+    s2 = swarm.converge(s, gcounter.join, neutral)
+    # dead replica's unique writes are invisible to the others...
+    alive_val = np.asarray(gcounter.value(s2.state))[0]
+    full_total = np.asarray(s.state.counts).max(axis=0).sum()
+    assert alive_val == full_total - before.sum()
+    # ...and its own state did not move
+    assert (np.asarray(s2.state.counts[dead]) == before).all()
+
+    # revive: one catch-up round restores full convergence (main.go:159 —
+    # gossip always ships full state)
+    s3 = swarm.set_alive(s2, dead, True)
+    s3 = swarm.converge(s3, gcounter.join, neutral)
+    assert (np.asarray(gcounter.value(s3.state)) == full_total).all()
+
+
+def test_oplog_swarm_gossip_converges():
+    rng = np.random.default_rng(3)
+    r, cap = 8, 64
+    logs = helpers.rand_oplog_family(rng, n_logs=r, capacity=cap, pool=30, take=10)
+    state = jax.tree.map(lambda *xs: jnp.stack(xs), *logs)
+    s = swarm.make(state)
+    join_b = jax.vmap(oplog.merge)
+    neutral = oplog.empty(cap)
+
+    key = jax.random.key(7)
+    for _ in range(30):
+        key, k = jax.random.split(key)
+        peers = swarm.random_peers(k, r)
+        s = swarm.gossip_round(s, peers, join_b)
+        if int(swarm.n_diverged(s, join_b, neutral)) == 0:
+            break
+    assert int(swarm.n_diverged(s, join_b, neutral)) == 0
+
+    # fixpoint state = union of all logs (order-free), same as one-shot
+    one_shot = swarm.converge(swarm.make(state), join_b, neutral)
+    assert tree_equal(s.state, one_shot.state)
+
+
+def test_pncounter_swarm_value_conservation():
+    rng = np.random.default_rng(4)
+    r, nodes = 12, 12
+    pos = np.zeros((r, nodes), np.int32)
+    neg = np.zeros((r, nodes), np.int32)
+    deltas = rng.integers(-20, -10, r)  # reference workload: all-negative
+    for i, d in enumerate(deltas):
+        neg[i, i] = -d
+    s = swarm.make(pncounter.PNCounter(pos=jnp.asarray(pos), neg=jnp.asarray(neg)))
+    s = swarm.converge(s, pncounter.join, pncounter.zero(nodes))
+    assert (np.asarray(pncounter.value(s.state)) == deltas.sum()).all()
