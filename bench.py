@@ -1,0 +1,83 @@
+"""Headline benchmark: G-Counter replica-merges/sec on one chip.
+
+BASELINE.md north star: >=100M G-Counter replica-merges/sec on a single v5e
+chip (the reference's merge hot path, /root/reference/main.go:35-100, runs at
+~0.67 merges/sec/replica over loopback HTTP; here one fused elementwise-max
+over a (replicas, nodes) plane merges the whole swarm per call).
+
+Measurement notes (both matter on this tunnel-attached chip):
+* Host<->device round-trips cost ~75 ms through the relay, so K merges are
+  chained inside ONE jitted fori_loop and the per-merge time is the
+  difference quotient between two K values (RTT cancels).
+* XLA's algebraic simplifier collapses loops of idempotent `max(x, b)` (and
+  even `max(x, b + i)`) into O(1) work, which silently benchmarks nothing.
+  The loop body therefore joins against a BANK of distinct peer states
+  selected by dynamic index (`B[i % BANK]`) — data-dependent, so no
+  algebraic collapse is possible, with the same 2-read/1-write memory
+  traffic as a real merge.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is value / 100e6 (the BASELINE target; the reference publishes
+no numbers of its own — BASELINE.md "published: none").
+"""
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+TARGET = 100e6   # replica-merges/sec, BASELINE.md north star
+R = 1 << 20      # 1M replicas (north-star scale)
+N_NODES = 8
+BANK = 16        # distinct peer states cycled through the loop
+K_SMALL, K_LARGE = 64, 512
+REPS = 5
+
+
+@partial(jax.jit, static_argnames="k")
+def chained_merges(a, bank, k):
+    def body(i, x):
+        peer = jax.lax.dynamic_index_in_dim(bank, i % BANK, keepdims=False)
+        return jnp.maximum(x, peer)
+
+    out = jax.lax.fori_loop(0, k, body, a)
+    return out.sum()  # 8-byte result; fetching it forces completion
+
+
+def timed(a, bank, k):
+    _ = int(chained_merges(a, bank, k))  # compile + warm
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        _ = int(chained_merges(a, bank, k))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    ka, kb = jax.random.split(jax.random.key(0))
+    a = jax.random.randint(ka, (R, N_NODES), 0, 1 << 20, dtype=jnp.int32)
+    bank = jax.random.randint(kb, (BANK, R, N_NODES), 0, 1 << 20, dtype=jnp.int32)
+
+    t_small = timed(a, bank, K_SMALL)
+    t_large = timed(a, bank, K_LARGE)
+    per_merge = (t_large - t_small) / (K_LARGE - K_SMALL)
+
+    merges_per_sec = R / per_merge
+    print(
+        json.dumps(
+            {
+                "metric": "gcounter_replica_merges_per_sec_1M",
+                "value": round(merges_per_sec, 1),
+                "unit": "replica-merges/s",
+                "vs_baseline": round(merges_per_sec / TARGET, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

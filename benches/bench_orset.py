@@ -1,0 +1,138 @@
+"""OR-Set union benchmark: Pallas bitonic-merge kernel vs XLA sort fallback.
+
+BASELINE config: 1M replicas x 1K elements, sorted-segment union.  Run on
+the TPU chip (ambient JAX_PLATFORMS=axon); prints a comparison table.
+Timing uses the same RTT-cancellation as bench.py: K chained unions inside
+one jit, difference quotient between two K values.
+"""
+import argparse
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crdt_tpu.ops import pallas_union
+from crdt_tpu.ops import sorted_union as su
+from crdt_tpu.utils.constants import SENTINEL
+
+
+def make_columns(key, c, lanes, fill):
+    """Per-lane sorted unique packed tags with SENTINEL padding."""
+    ks = jax.random.randint(key, (c, lanes), 0, 1 << 30, dtype=jnp.int32)
+    ks = jax.lax.sort(ks, dimension=0)
+    mask = jnp.arange(c)[:, None] < fill
+    keys = jnp.where(mask, ks, SENTINEL)
+    vals = (ks & 1).astype(jnp.int32)
+    return keys, vals
+
+
+@partial(jax.jit, static_argnames=("k", "interpret"))
+def chained_pallas(ka, va, bank_k, bank_v, k, interpret=False):
+    c = ka.shape[0]
+
+    def body(i, carry):
+        kk, vv = carry
+        j = i % bank_k.shape[0]
+        kb = jax.lax.dynamic_index_in_dim(bank_k, j, keepdims=False)
+        vb = jax.lax.dynamic_index_in_dim(bank_v, j, keepdims=False)
+        ko, vo, _ = pallas_union.sorted_union_columnar(
+            kk, vv, kb, vb, out_size=c, interpret=interpret
+        )
+        return ko, vo
+
+    ko, vo = jax.lax.fori_loop(0, k, body, (ka, va))
+    return ko.sum() + vo.sum()
+
+
+@partial(jax.jit, static_argnames=("k",))
+def chained_xla(ka, va, bank_k, bank_v, k):
+    """Fallback: generic sorted_union vmapped over lanes (row-major)."""
+    c = ka.shape[0]
+
+    def one_union(kk, vv, kb, vb):
+        keys, vals, _ = su.sorted_union((kk,), vv, (kb,), vb,
+                                        combine=lambda x, y: x | y, out_size=c)
+        return keys[0], vals
+
+    def body(i, carry):
+        kk, vv = carry
+        j = i % bank_k.shape[0]
+        kb = jax.lax.dynamic_index_in_dim(bank_k, j, keepdims=False)
+        vb = jax.lax.dynamic_index_in_dim(bank_v, j, keepdims=False)
+        ko, vo = jax.vmap(one_union, in_axes=1, out_axes=1)(kk, vv, kb, vb)
+        return ko, vo
+
+    ko, vo = jax.lax.fori_loop(0, k, body, (ka, va))
+    return ko.sum() + vo.sum()
+
+
+def timed(fn, k_small, k_large, reps=3):
+    def run(k):
+        _ = int(fn(k))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _ = int(fn(k))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t2 = run(k_small), run(k_large)
+    return (t2 - t1) / (k_large - k_small)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--capacity", type=int, default=1024)
+    ap.add_argument("--lanes", type=int, default=1 << 20,
+                    help="replicas (BASELINE: 1M)")
+    ap.add_argument("--bank", type=int, default=4)
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--interpret", action="store_true")
+    ap.add_argument("--skip-xla", action="store_true")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (smoke runs)")
+    args = ap.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    c, lanes = args.capacity, args.lanes
+    keys = jax.random.split(jax.random.key(0), args.bank + 1)
+    ka, va = make_columns(keys[0], c, lanes, fill=c // 2)
+    bank = [make_columns(k2, c, lanes, fill=c // 2) for k2 in keys[1:]]
+    bank_k = jnp.stack([b[0] for b in bank])
+    bank_v = jnp.stack([b[1] for b in bank])
+
+    if args.interpret:
+        # smoke mode: interpret-pallas inside fori_loop is pathologically
+        # slow; just run a couple of eager unions to prove the path works
+        ko, vo, _ = pallas_union.sorted_union_columnar(
+            ka, va, bank_k[0], bank_v[0], out_size=c, interpret=True
+        )
+        jax.block_until_ready((ko, vo))
+        print(f"interpret smoke OK: union C={c} lanes={lanes}")
+        return
+
+    per = timed(
+        lambda k: chained_pallas(ka, va, bank_k, bank_v, k, args.interpret),
+        args.k, 4 * args.k,
+    )
+    rate = lanes / per
+    print(f"pallas bitonic union: {per*1e3:.2f} ms/union-step "
+          f"({rate/1e6:.1f}M replica-unions/s @ C={c})")
+
+    if not args.skip_xla:
+        per_x = timed(lambda k: chained_xla(ka, va, bank_k, bank_v, k),
+                      max(args.k // 4, 2), args.k)
+        print(f"xla sort fallback:    {per_x*1e3:.2f} ms/union-step "
+              f"({lanes/per_x/1e6:.1f}M replica-unions/s) "
+              f"-> speedup x{per_x/per:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -112,3 +112,84 @@ def member_mask(s: ORSet, n_universe: int) -> jax.Array:
 def _resort(s: ORSet):
     out = jax.lax.sort([s.elem, s.rid, s.seq, s.removed], num_keys=3, is_stable=True)
     return out[:3], out[3]
+
+
+# ---- columnar swarm fast path (Pallas bitonic-merge union) ----
+#
+# The canonical high-throughput layout for a *swarm* of OR-Sets puts the
+# replica axis on TPU lanes: packed tag keys as int32[C, R] (see
+# crdt_tpu.ops.pallas_union for why this layout wins).  Tags are bit-packed
+# (crdt_tpu.ops.pack); the removed flag rides the value plane.
+
+
+def stack_to_columnar(sets):
+    """Stack single-instance ORSets (a Python list or a vmapped [R, C]
+    batch) into (packed_keys[C, R], removed[C, R]) columnar planes."""
+    import numpy as np
+
+    from crdt_tpu.ops import pack
+
+    if isinstance(sets, ORSet):
+        elem, rid, seq, removed = sets.elem, sets.rid, sets.seq, sets.removed
+    else:
+        elem = jnp.stack([s.elem for s in sets])
+        rid = jnp.stack([s.rid for s in sets])
+        seq = jnp.stack([s.seq for s in sets])
+        removed = jnp.stack([s.removed for s in sets])
+    # single instance -> one lane; batched [R, C] -> R lanes
+    elem, rid, seq, removed = map(jnp.atleast_2d, (elem, rid, seq, removed))
+    valid = elem != SENTINEL
+    # host-side staging: verify the tag space fits the packed bit budget —
+    # out-of-budget fields would bleed across bit boundaries and silently
+    # corrupt the join's sort order
+    ev, rv, sv = (np.asarray(jnp.where(valid, x, 0)) for x in (elem, rid, seq))
+    pack.check_budget(
+        int(ev.max(initial=0)) + 1, int(rv.max(initial=0)) + 1, int(sv.max(initial=0)) + 1
+    )
+    packed = jnp.where(valid, pack.pack_tags(elem, rid, seq), SENTINEL)
+    return packed.T, jnp.where(valid, removed, False).astype(jnp.int32).T
+
+
+def columnar_join(packed_a, removed_a, packed_b, removed_b, out_size=None,
+                  interpret: bool = False):
+    """Swarm-wide OR-Set join in the columnar layout: one Pallas bitonic
+    merge + fused tombstone-OR dedupe.  Returns (packed, removed, n_unique);
+    n_unique[j] > out_size means lane j overflowed (largest tags dropped).
+
+    Lane counts that aren't a multiple of the kernel's 128-lane tile are
+    padded with empty columns here and sliced back off the outputs."""
+    from crdt_tpu.ops import pallas_union
+
+    out = out_size if out_size is not None else packed_a.shape[0]
+    lanes = packed_a.shape[1]
+    pad = (-lanes) % pallas_union.LANES
+    if pad:
+        def padk(k):
+            return jnp.pad(k, ((0, 0), (0, pad)), constant_values=int(SENTINEL))
+
+        def padv(v):
+            return jnp.pad(v, ((0, 0), (0, pad)))
+
+        packed_a, packed_b = padk(packed_a), padk(packed_b)
+        removed_a, removed_b = padv(removed_a), padv(removed_b)
+    keys, vals, n = pallas_union.sorted_union_columnar(
+        packed_a, removed_a, packed_b, removed_b,
+        out_size=out, interpret=interpret,
+    )
+    if pad:
+        keys, vals, n = keys[:, :lanes], vals[:, :lanes], n[:lanes]
+    return keys, vals, n
+
+
+def columnar_member_mask(packed, removed, n_universe: int):
+    """bool[n_universe, R]: per-lane element membership (>=1 live tag)."""
+    from crdt_tpu.ops import pack
+
+    valid = packed != SENTINEL
+    elem, _, _ = pack.unpack_tags(jnp.where(valid, packed, 0))
+    idx = jnp.where(valid, elem, n_universe)
+    lanes = packed.shape[1]
+    live = (valid & (removed == 0)).astype(jnp.int32)
+    mask = jnp.zeros((n_universe + 1, lanes), jnp.int32)
+    mask = mask.at[idx, jnp.arange(lanes)[None, :].repeat(packed.shape[0], 0)].max(live)
+    return mask[:n_universe] > 0

@@ -1,0 +1,43 @@
+"""Bit-packing multi-column keys into single int32 lanes for the Pallas
+union kernel (which compares one int32 key plane).
+
+An OR-Set tag is (elem, rid, seq); the generic XLA path compares the three
+columns lexicographically, but the TPU kernel wants one comparable word.
+Packing budgets are explicit and checked host-side: the default split is
+elem:14 | rid:6 | seq:11 bits (16K elements, 64 replicas-of-origin, 2K seqs
+per (elem, rid)), leaving the sign bit clear so packed keys stay
+non-negative and below SENTINEL.  Lexicographic order of (elem, rid, seq)
+== numeric order of the packed word."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+ELEM_BITS, RID_BITS, SEQ_BITS = 14, 6, 11
+assert ELEM_BITS + RID_BITS + SEQ_BITS == 31  # sign bit stays clear
+
+
+def pack_tags(elem: jax.Array, rid: jax.Array, seq: jax.Array) -> jax.Array:
+    """Pack (elem, rid, seq) int32 columns into one order-preserving int32.
+    SENTINEL rows (all-ones) map to values >= 2^31 - 2^31 stays SENTINEL-like
+    because every field saturates; callers should pack only valid rows and
+    re-pad with SENTINEL."""
+    return (
+        (elem << (RID_BITS + SEQ_BITS)) | (rid << SEQ_BITS) | seq
+    ).astype(jnp.int32)
+
+
+def unpack_tags(packed: jax.Array):
+    seq = packed & ((1 << SEQ_BITS) - 1)
+    rid = (packed >> SEQ_BITS) & ((1 << RID_BITS) - 1)
+    elem = (packed >> (RID_BITS + SEQ_BITS)) & ((1 << ELEM_BITS) - 1)
+    return elem, rid, seq
+
+
+def check_budget(n_elems: int, n_rids: int, n_seqs: int) -> None:
+    if n_elems > 1 << ELEM_BITS or n_rids > 1 << RID_BITS or n_seqs > 1 << SEQ_BITS:
+        raise ValueError(
+            f"tag space ({n_elems}, {n_rids}, {n_seqs}) exceeds the packed "
+            f"budget ({1 << ELEM_BITS}, {1 << RID_BITS}, {1 << SEQ_BITS}); "
+            "use the generic crdt_tpu.ops.sorted_union path instead"
+        )

@@ -8,13 +8,17 @@ without enabling jax_enable_x64; uniqueness at TPU rates comes from the
 log-key collision (see SURVEY.md §0.1.2, /root/reference/main.go:187).
 """
 import jax.numpy as jnp
+import numpy as np
 
 # Padding sentinel for sorted array-encoded sets/logs.  Real keys are
-# strictly below it, so padded rows sort to the tail.
-SENTINEL = jnp.int32(2**31 - 1)
+# strictly below it, so padded rows sort to the tail.  numpy scalars, NOT
+# jnp: creating a jax array at import time would initialize the backend
+# before the caller can pick a platform (and the ambient platform here is a
+# tunnel-attached TPU that may not be reachable).
+SENTINEL = np.int32(2**31 - 1)
 SENTINEL_PY = 2**31 - 1
 
 # "No value yet" timestamp for LWW registers (all real ts are >= 0).
-TS_NULL = jnp.int32(-1)
+TS_NULL = np.int32(-1)
 
 DEFAULT_DTYPE = jnp.int32

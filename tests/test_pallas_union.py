@@ -1,0 +1,92 @@
+"""Pallas bitonic-merge union kernel tests (interpret mode on CPU; the real
+Mosaic path runs in bench_orset.py on hardware).  Ground truth: python sets
+and the generic XLA sorted_union."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from crdt_tpu.ops import pack, pallas_union
+from crdt_tpu.utils.constants import SENTINEL_PY
+
+
+def _cols(rng, c, lanes, fill_max):
+    """Per-lane sorted unique keys with SENTINEL padding + random vals."""
+    keys = np.full((c, lanes), SENTINEL_PY, np.int32)
+    vals = np.zeros((c, lanes), np.int32)
+    for j in range(lanes):
+        n = int(rng.integers(0, c + 1))
+        ks = np.sort(rng.choice(fill_max, size=n, replace=False))
+        keys[:n, j] = ks
+        vals[:n, j] = rng.integers(0, 8, n)  # small flags, OR-combinable
+    return jnp.asarray(keys), jnp.asarray(vals)
+
+
+@pytest.mark.parametrize("c", [8, 64])
+def test_columnar_union_matches_python_sets(c):
+    rng = np.random.default_rng(c)
+    lanes = 128
+    ka, va = _cols(rng, c, lanes, fill_max=4 * c)
+    kb, vb = _cols(rng, c, lanes, fill_max=4 * c)
+    ko, vo, n = pallas_union.sorted_union_columnar(ka, va, kb, vb, interpret=True)
+    ko, vo, n = np.asarray(ko), np.asarray(vo), np.asarray(n)
+
+    for j in range(0, lanes, 17):  # spot-check lanes
+        expect = {}
+        for kk, vv in zip(np.asarray(kb)[:, j], np.asarray(vb)[:, j]):
+            if kk != SENTINEL_PY:
+                expect[int(kk)] = int(vv)
+        for kk, vv in zip(np.asarray(ka)[:, j], np.asarray(va)[:, j]):
+            if kk != SENTINEL_PY:
+                expect[int(kk)] = expect.get(int(kk), 0) | int(vv)
+        got_keys = [int(k) for k in ko[:, j] if k != SENTINEL_PY]
+        got = {k: int(v) for k, v in zip(got_keys, vo[:, j])}
+        assert got == expect, f"lane {j}"
+        assert n[j] == len(expect)
+        assert got_keys == sorted(got_keys)
+
+
+def test_merge_kernel_is_sorted_even_with_dups():
+    rng = np.random.default_rng(3)
+    c, lanes = 32, 128
+    ka, va = _cols(rng, c, lanes, fill_max=c)  # dense => many cross dups
+    kb, vb = _cols(rng, c, lanes, fill_max=c)
+    ko, _ = pallas_union.bitonic_merge_columnar(ka, va, kb, vb, interpret=True)
+    ko = np.asarray(ko)
+    assert (np.diff(ko, axis=0) >= 0).all(), "merged columns must be sorted"
+
+
+def test_pack_roundtrip_and_order():
+    rng = np.random.default_rng(0)
+    elem = rng.integers(0, 1 << pack.ELEM_BITS, 1000)
+    rid = rng.integers(0, 1 << pack.RID_BITS, 1000)
+    seq = rng.integers(0, 1 << pack.SEQ_BITS, 1000)
+    packed = np.asarray(pack.pack_tags(jnp.asarray(elem), jnp.asarray(rid), jnp.asarray(seq)))
+    assert (packed >= 0).all()
+    e2, r2, s2 = (np.asarray(x) for x in pack.unpack_tags(jnp.asarray(packed)))
+    assert (e2 == elem).all() and (r2 == rid).all() and (s2 == seq).all()
+    # numeric order == lexicographic order
+    tuples = list(zip(elem, rid, seq))
+    assert np.argsort(packed, kind="stable").tolist() == sorted(
+        range(1000), key=lambda i: (tuples[i], i)
+    )
+    with pytest.raises(ValueError):
+        pack.check_budget(1 << 20, 2, 2)
+
+
+def test_columnar_union_agrees_with_generic_sorted_union():
+    from crdt_tpu.ops import sorted_union as su
+
+    rng = np.random.default_rng(9)
+    c, lanes = 16, 128
+    ka, va = _cols(rng, c, lanes, fill_max=64)
+    kb, vb = _cols(rng, c, lanes, fill_max=64)
+    ko, vo, _ = pallas_union.sorted_union_columnar(ka, va, kb, vb, interpret=True)
+
+    for j in range(0, lanes, 31):
+        keys, vals, _ = su.sorted_union(
+            (ka[:, j],), va[:, j], (kb[:, j],), vb[:, j],
+            combine=lambda x, y: x | y,
+        )
+        assert np.asarray(keys[0]).tolist() == np.asarray(ko[:, j]).tolist()
+        assert np.asarray(vals).tolist() == np.asarray(vo[:, j]).tolist()
