@@ -1,0 +1,2 @@
+from crdt_tpu.api.node import ReplicaNode  # noqa: F401
+from crdt_tpu.api.cluster import LocalCluster  # noqa: F401

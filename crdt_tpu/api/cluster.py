@@ -1,0 +1,104 @@
+"""LocalCluster: N in-process replicas + the anti-entropy scheduler — the
+TPU-native answer to the reference's bootstrap (createServer + main,
+/root/reference/main.go:217-271, 316-327).
+
+The reference's answer to "multi-node without a cluster" is in-process
+multi-instance (SURVEY.md §4); same here, with two gossip drivers:
+
+* `tick()` — deterministic manual rounds (tests, soak harness);
+* `start()/stop()` — background threads pulling a random friend every
+  gossip_period_ms, the reference's live topology (including, optionally,
+  its self-and-dead-ports friend list, quirk §0.1.9).
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional
+
+from crdt_tpu.api.node import ReplicaNode
+from crdt_tpu.utils.clock import HostClock
+from crdt_tpu.utils.config import ClusterConfig
+from crdt_tpu.utils.metrics import Metrics
+
+
+class LocalCluster:
+    def __init__(self, config: Optional[ClusterConfig] = None):
+        self.config = config or ClusterConfig()
+        self.metrics = Metrics()
+        clock = HostClock()
+        self.nodes: List[ReplicaNode] = [
+            ReplicaNode(
+                rid=i,
+                capacity=self.config.log_capacity,
+                clock=clock,
+                metrics=self.metrics,
+            )
+            for i in range(self.config.n_replicas)
+        ]
+        self._rng = random.Random(self.config.seed)
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ---- addressing (reference topology: ports) ----
+
+    def node_by_port(self, port: int) -> Optional[ReplicaNode]:
+        idx = port - self.config.base_port
+        if 0 <= idx < len(self.nodes):
+            return self.nodes[idx]
+        return None  # a never-started friend port (quirk §0.1.9)
+
+    def _friend_pool(self, rid: int) -> List[Optional[ReplicaNode]]:
+        if self.config.reference_topology:
+            # self + all friend ports, live or not (main.go:220-222)
+            return [self.node_by_port(p) for p in self.config.friend_ports()]
+        return [n for n in self.nodes if n.rid != rid]
+
+    # ---- deterministic gossip rounds ----
+
+    def gossip_once(self, rid: int) -> bool:
+        """One pull by replica `rid` from a random friend; returns True if a
+        merge happened (dead/missing peers are skipped, main.go:235-239)."""
+        node = self.nodes[rid]
+        peer = self._rng.choice(self._friend_pool(rid))
+        if peer is None or peer is node or not peer.alive or not node.alive:
+            self.metrics.inc("gossip_skipped")
+            return False
+        payload = peer.gossip_payload()
+        if payload is None:
+            self.metrics.inc("gossip_skipped")
+            return False
+        node.receive(payload)
+        self.metrics.inc("gossip_rounds")
+        return True
+
+    def tick(self) -> int:
+        """One gossip round for every replica; returns merges performed."""
+        return sum(self.gossip_once(rid) for rid in range(len(self.nodes)))
+
+    def converged(self) -> bool:
+        states = [n.get_state() for n in self.nodes if n.alive]
+        return all(s == states[0] for s in states[1:]) if states else True
+
+    def states(self) -> List[Optional[Dict[str, str]]]:
+        return [n.get_state() for n in self.nodes]
+
+    # ---- background scheduler (reference-live mode) ----
+
+    def start(self) -> None:
+        self._stop.clear()
+        for rid in range(len(self.nodes)):
+            t = threading.Thread(target=self._loop, args=(rid,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    def _loop(self, rid: int) -> None:
+        period = self.config.gossip_period_ms / 1000.0
+        while not self._stop.wait(period):
+            self.gossip_once(rid)

@@ -1,0 +1,130 @@
+"""HTTP shim: the reference's five-endpoint REST surface over ReplicaNodes,
+for black-box parity testing against the Go server (SURVEY.md §2 #5/#10).
+
+Routes (1:1 with /root/reference/main.go:262-266):
+  GET  /gossip                  full op log as JSON        (main.go:154-171)
+  GET  /ping                    200 "Pong" / 502           (main.go:115-127)
+  GET  /data                    materialized state JSON    (main.go:129-139)
+  POST /data                    append command, "Inserted" (main.go:173-215)
+  GET  /condition/<bool>        set alive                  (main.go:141-152)
+
+The /condition route takes the flag as a path segment (also accepted:
+?alive_status=) — the reference registered the route without the parameter
+binding so every call 500'd (quirk §0.1.7); this shim implements what that
+endpoint was meant to do.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from crdt_tpu.api.cluster import LocalCluster
+
+
+def _make_handler(cluster: LocalCluster, idx: int):
+    class Handler(BaseHTTPRequestHandler):
+        # resolve at request time: a node may be replaced in the cluster
+        # (crash + checkpoint-restore) and the port must follow it
+        @property
+        def node(self):
+            return cluster.nodes[idx]
+        def log_message(self, *args):  # quiet (gin's request log equivalent off)
+            pass
+
+        def _send(self, code: int, body: str, ctype: str = "text/plain"):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            if url.path == "/ping":
+                if self.node.ping():
+                    self._send(200, "Pong")
+                else:
+                    self._send(502, "Unreachable")
+            elif url.path == "/data":
+                state = self.node.get_state()
+                if state is None:
+                    self._send(502, "Unreachable")
+                else:
+                    self._send(200, json.dumps(state), "application/json")
+            elif url.path == "/gossip":
+                payload = self.node.gossip_payload()
+                if payload is None:
+                    self._send(502, "Unreachable")
+                else:
+                    self._send(200, json.dumps(payload), "application/json")
+            elif parts and parts[0] == "condition":
+                flag = None
+                if len(parts) > 1:
+                    flag = parts[1]
+                else:
+                    q = parse_qs(url.query)
+                    flag = q.get("alive_status", [None])[0]
+                if flag is None or flag.lower() not in ("true", "false", "1", "0"):
+                    self._send(500, "invalid alive_status")
+                    return
+                self.node.set_alive(flag.lower() in ("true", "1"))
+                self._send(200, "OK")
+            else:
+                self._send(404, "not found")
+
+        def do_POST(self):
+            if urlparse(self.path).path != "/data":
+                self._send(404, "not found")
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                cmd = json.loads(self.rfile.read(n) or b"{}")
+                assert isinstance(cmd, dict)
+                cmd = {str(k): str(v) for k, v in cmd.items()}
+            except Exception:
+                self._send(500, "Request body is invalid")  # main.go:179-186
+                return
+            if self.node.add_command(cmd):
+                self._send(200, "Inserted")  # main.go:208
+            else:
+                self._send(502, "Unreachable")
+
+    return Handler
+
+
+class HttpCluster:
+    """Serve every node of a LocalCluster on its reference port."""
+
+    def __init__(self, cluster: LocalCluster, host: str = "127.0.0.1"):
+        self.cluster = cluster
+        self.host = host
+        self.servers: List[ThreadingHTTPServer] = []
+        self.ports: List[int] = []
+        self._threads: List[threading.Thread] = []
+
+    def start(self, ports: Optional[List[int]] = None) -> List[int]:
+        ports = ports or [0] * len(self.cluster.nodes)  # 0 = ephemeral
+        for idx, port in enumerate(ports[: len(self.cluster.nodes)]):
+            srv = ThreadingHTTPServer(
+                (self.host, port), _make_handler(self.cluster, idx)
+            )
+            self.servers.append(srv)
+            self.ports.append(srv.server_address[1])
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self.ports
+
+    def stop(self) -> None:
+        for srv in self.servers:
+            srv.shutdown()
+            srv.server_close()
+        for t in self._threads:
+            t.join(timeout=5)
+        self.servers.clear()
+        self._threads.clear()

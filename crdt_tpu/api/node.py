@@ -1,0 +1,215 @@
+"""ReplicaNode: the host-side replica — the TPU-native answer to the
+reference's `Server` struct (/root/reference/main.go:23-33).
+
+Mirrors the five capabilities of the reference's HTTP surface as plain
+methods (the HTTP shim in crdt_tpu.api.http_shim wraps them 1:1):
+
+  add_command  <- POST /data   (main.go:173-215)
+  get_state    <- GET  /data   (main.go:129-139)
+  gossip_payload / receive <- GET /gossip + the pull loop (main.go:154-171,
+                               226-261)
+  ping         <- GET  /ping   (main.go:115-127)
+  set_alive    <- GET  /condition (main.go:141-152; routing bug §0.1.7 fixed)
+
+Distributed-honesty note: gossip payloads carry STRINGS (like the Go JSON
+wire format), and each node interns into its own table on receipt — two
+nodes never need to share an interner, so the same code path works across
+process/host boundaries.  The in-process swarm engine (crdt_tpu.parallel)
+is the shared-interner fast path.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from crdt_tpu.models import oplog
+from crdt_tpu.utils.clock import HostClock, SeqGen
+from crdt_tpu.utils.intern import Interner, encode_value
+from crdt_tpu.utils.metrics import Metrics
+
+# Wire key for an op: "ts:rid:seq" (the fixed, collision-free op identity —
+# reference quirk §0.1.2 fixed).  Timestamps travel as ABSOLUTE Unix
+# milliseconds — nodes in different processes have different int32 epochs,
+# so the wire carries the epoch-free value and each receiver rebases onto
+# its own epoch.  Plain integer keys (a Go peer's UnixMilli log keys,
+# main.go:187) are accepted with rid=-1, seq=0.
+INT32_MIN, INT32_MAX = -(2**31), 2**31 - 1
+
+
+def _wire_key(ts_abs: int, rid: int, seq: int) -> str:
+    return f"{ts_abs}:{rid}:{seq}"
+
+
+def _parse_wire_key(k: str) -> Tuple[int, int, int]:
+    if ":" in k:
+        ts, rid, seq = k.split(":")
+        return int(ts), int(rid), int(seq)
+    return int(k), -1, 0  # Go-format key: millisecond timestamp only
+
+
+class ReplicaNode:
+    def __init__(
+        self,
+        rid: int,
+        capacity: int = 1024,
+        clock: Optional[HostClock] = None,
+        metrics: Optional[Metrics] = None,
+        use_native: Optional[bool] = None,
+    ):
+        from crdt_tpu import native
+
+        self.rid = rid
+        self.clock = clock or HostClock()
+        self.metrics = metrics or Metrics()
+        # native C++ interner + batch packer when built (identical semantics,
+        # tests/test_native.py); pure-Python otherwise
+        self._native = native.AVAILABLE if use_native is None else use_native
+        if self._native:
+            self.keys = native.NativeInterner()
+            self.values = native.NativeInterner()
+            self._packer = native.OpBatchPacker(self.keys, self.values)
+        else:
+            self.keys = Interner()
+            self.values = Interner()
+            self._packer = None
+        self.log = oplog.empty(capacity)
+        self.alive = True
+        self._seq = SeqGen()
+        self._lock = threading.Lock()
+        # host copy of raw commands per op, for gossip serving:
+        # (ts, rid, seq) -> {key: value}
+        self._commands: Dict[Tuple[int, int, int], Dict[str, str]] = {}
+
+    # ---- write path ----
+
+    def add_command(self, cmd: Dict[str, str], ts: Optional[int] = None) -> bool:
+        """POST /data: append one multi-key command.  Returns False when the
+        node is down (the reference 502s, main.go:210-212)."""
+        with self._lock:
+            if not self.alive:
+                return False
+            ts = self.clock.now_ms() if ts is None else ts
+            seq = self._seq.next()
+            with self.metrics.timer("write"):
+                self._ingest([(ts, self.rid, seq, dict(cmd))])
+            return True
+
+    # ---- read path ----
+
+    def get_state(self) -> Optional[Dict[str, str]]:
+        """GET /data: the materialized key-value view (None when down)."""
+        if not self.alive:
+            return None
+        with self._lock:
+            # round the key space up to a power of two: rebuild's n_keys is a
+            # static jit arg, so this bounds recompiles to O(log K) instead of
+            # one per newly-interned key (materialize only reads len(keys))
+            n = 16
+            while n < len(self.keys):
+                n *= 2
+            kv = oplog.rebuild(self.log, n_keys=n)
+            return oplog.materialize(kv, self.keys, self.values)
+
+    # ---- gossip ----
+
+    def gossip_payload(self) -> Optional[Dict[str, Dict[str, str]]]:
+        """GET /gossip: the full op log as wire JSON (None when down —
+        caller skips, mirroring the 502 path main.go:166-169)."""
+        if not self.alive:
+            return None
+        epoch = self.clock.epoch_ms
+        with self._lock:
+            return {
+                _wire_key(k[0] + epoch, k[1], k[2]): dict(v)
+                for k, v in sorted(self._commands.items())
+            }
+
+    def receive(self, payload: Optional[Dict[str, Dict[str, str]]]) -> None:
+        """Pull-side merge of a peer's gossip payload (main.go:250-257).
+        Unknown strings are interned locally; a malformed key raises
+        ValueError (the reference silently killed its gossip loop forever,
+        quirk §0.1.8 — failing loudly is the fix)."""
+        if not payload or not self.alive:
+            return
+        epoch = self.clock.epoch_ms
+        rows = []
+        for k, cmd in payload.items():
+            ts_abs, rid, seq = _parse_wire_key(k)
+            ts = ts_abs - epoch  # rebase onto this node's int32 window
+            if not (INT32_MIN <= ts <= INT32_MAX):
+                raise ValueError(
+                    f"gossip timestamp {ts_abs} is outside this node's int32 "
+                    f"window (epoch {epoch}); reference quirk §0.1.8 made this "
+                    "kill gossip silently — here it fails loudly"
+                )
+            rows.append((ts, rid, seq, cmd))
+        with self._lock:
+            with self.metrics.timer("merge"):
+                self._ingest(rows)
+
+    # ---- health / fault injection ----
+
+    def ping(self) -> bool:
+        return self.alive
+
+    def set_alive(self, alive: bool) -> None:
+        self.alive = bool(alive)
+
+    # ---- internals ----
+
+    def _ingest(self, rows: List[Tuple[int, int, int, Dict[str, str]]]) -> None:
+        """Append/merge op rows (caller holds the lock).  Grows the log
+        (2x) instead of silently dropping ops at capacity overflow."""
+        fresh = 0
+        if self._packer is not None:  # native packing path
+            for ts, rid, seq, cmd in rows:
+                ident = (ts, rid, seq)
+                if ident in self._commands:
+                    continue  # duplicate op (gossip re-delivery): union no-op
+                self._commands[ident] = dict(cmd)
+                for k, v in cmd.items():
+                    self._packer.add(ts, rid, seq, k, v)
+                    fresh += 1
+            if not fresh:
+                return
+            ops = self._packer.take()
+        else:
+            cols = {n: [] for n in ("ts", "rid", "seq", "key", "val", "payload", "is_num")}
+            for ts, rid, seq, cmd in rows:
+                ident = (ts, rid, seq)
+                if ident in self._commands:
+                    continue
+                self._commands[ident] = dict(cmd)
+                for k, v in cmd.items():
+                    val, payload, is_num = encode_value(v, self.values)
+                    cols["ts"].append(ts)
+                    cols["rid"].append(rid)
+                    cols["seq"].append(seq)
+                    cols["key"].append(self.keys.intern(k))
+                    cols["val"].append(val)
+                    cols["payload"].append(payload)
+                    cols["is_num"].append(is_num)
+                    fresh += 1
+            if not fresh:
+                return
+            ops = {
+                n: np.asarray(c, bool if n == "is_num" else np.int32)
+                for n, c in cols.items()
+            }
+        needed = int(oplog.size(self.log)) + fresh
+        while needed > self.log.capacity:
+            self._grow()
+        batch_cap = max(fresh, 1)
+        merged, n_unique = oplog.merge_checked(
+            self.log, oplog.from_ops(batch_cap, ops)
+        )
+        assert int(n_unique) <= self.log.capacity
+        self.log = merged
+        self.metrics.inc("ops_ingested", fresh)
+
+    def _grow(self) -> None:
+        bigger = oplog.empty(self.log.capacity * 2)
+        self.log = oplog.merge(bigger, self.log)
+        self.metrics.inc("log_grow")

@@ -1,0 +1,1 @@
+from crdt_tpu.harness.workload import WorkloadGenerator  # noqa: F401

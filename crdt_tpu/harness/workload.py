@@ -1,0 +1,65 @@
+"""Workload generator — the reference's `dummyInsertions` re-created
+(/root/reference/main.go:273-314): random single-key commands with deltas in
+[-20, -11] (the reference's rand.Intn(10) + 2*(-10), main.go:275-282, which
+only ever produces negative deltas — quirk §0.1.10, reproduced faithfully by
+default and overridable via ClusterConfig) posted to a random replica.
+
+Two drive modes: in-process (LocalCluster) and HTTP (any server exposing the
+reference surface, including the Go original — the harness is usable for
+black-box A/B runs)."""
+from __future__ import annotations
+
+import json
+import random
+import urllib.request
+from typing import List, Optional, Tuple
+
+from crdt_tpu.api.cluster import LocalCluster
+from crdt_tpu.utils.config import ClusterConfig
+
+
+class WorkloadGenerator:
+    def __init__(self, config: Optional[ClusterConfig] = None, seed: Optional[int] = None):
+        self.config = config or ClusterConfig()
+        self._rng = random.Random(self.config.seed if seed is None else seed)
+
+    def next_command(self) -> Tuple[dict, int]:
+        """Returns ({key: delta}, target_replica_index)."""
+        c = self.config
+        key = c.key_alphabet[self._rng.randrange(len(c.key_alphabet))]
+        delta = self._rng.randint(c.delta_min, c.delta_max)
+        target = self._rng.randrange(c.n_replicas)
+        return {key: str(delta)}, target
+
+    # ---- in-process drive ----
+
+    def drive_cluster(self, cluster: LocalCluster, n_writes: int,
+                      gossip_every: int = 0) -> int:
+        """Apply n_writes random commands; optionally run a gossip tick every
+        `gossip_every` writes.  Returns accepted write count."""
+        accepted = 0
+        for i in range(n_writes):
+            cmd, target = self.next_command()
+            accepted += bool(cluster.nodes[target].add_command(cmd))
+            if gossip_every and (i + 1) % gossip_every == 0:
+                cluster.tick()
+        return accepted
+
+    # ---- HTTP drive (works against the Go reference too) ----
+
+    def drive_http(self, urls: List[str], n_writes: int, timeout: float = 5.0) -> int:
+        accepted = 0
+        for _ in range(n_writes):
+            cmd, target = self.next_command()
+            req = urllib.request.Request(
+                urls[target % len(urls)] + "/data",
+                data=json.dumps(cmd).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as res:
+                    accepted += res.status == 200
+            except Exception:
+                pass  # dead replica: skipped, like main.go:301-304
+        return accepted

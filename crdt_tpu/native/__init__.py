@@ -1,0 +1,152 @@
+"""ctypes bindings for the native ingestion runtime (ingest.cpp).
+
+Builds lazily via `make` on first import if the shared library is missing;
+falls back cleanly (`AVAILABLE = False`) when no toolchain is present, in
+which case callers use the pure-Python Interner/encode path
+(crdt_tpu.utils.intern) — identical semantics, verified in tests.
+"""
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_SO = _DIR / "libcrdt_ingest.so"
+
+AVAILABLE = False
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", str(_DIR), "-s"], check=True, capture_output=True
+        )
+        return _SO.exists()
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global AVAILABLE
+    if not _SO.exists() and not _build():
+        return None
+    lib = ctypes.CDLL(str(_SO))
+    lib.crdt_interner_new.restype = ctypes.c_void_p
+    lib.crdt_interner_free.argtypes = [ctypes.c_void_p]
+    lib.crdt_intern.restype = ctypes.c_int32
+    lib.crdt_intern.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+    lib.crdt_interner_size.restype = ctypes.c_int32
+    lib.crdt_interner_size.argtypes = [ctypes.c_void_p]
+    lib.crdt_interner_find.restype = ctypes.c_int32
+    lib.crdt_interner_find.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+    lib.crdt_lookup.restype = ctypes.POINTER(ctypes.c_char)
+    lib.crdt_lookup.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)
+    ]
+    lib.crdt_parse_go_int.restype = ctypes.c_int32
+    lib.crdt_parse_go_int.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)
+    ]
+    lib.crdt_batch_new.restype = ctypes.c_void_p
+    for name in ("crdt_batch_free", "crdt_batch_clear"):
+        getattr(lib, name).argtypes = [ctypes.c_void_p]
+    lib.crdt_batch_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+    ]
+    lib.crdt_batch_size.restype = ctypes.c_int32
+    lib.crdt_batch_size.argtypes = [ctypes.c_void_p]
+    for name in ("ts", "rid", "seq", "key", "val", "payload"):
+        fn = getattr(lib, f"crdt_batch_{name}")
+        fn.restype = ctypes.POINTER(ctypes.c_int32)
+        fn.argtypes = [ctypes.c_void_p]
+    lib.crdt_batch_is_num.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.crdt_batch_is_num.argtypes = [ctypes.c_void_p]
+    AVAILABLE = True
+    return lib
+
+
+_lib = _load()
+
+
+class NativeInterner:
+    """Drop-in replacement for crdt_tpu.utils.intern.Interner."""
+
+    def __init__(self):
+        assert _lib is not None, "native runtime unavailable"
+        self._h = _lib.crdt_interner_new()
+
+    def __del__(self):
+        if _lib is not None and getattr(self, "_h", None):
+            _lib.crdt_interner_free(self._h)
+            self._h = None
+
+    def intern(self, s: str) -> int:
+        b = s.encode()
+        return _lib.crdt_intern(self._h, b, len(b))
+
+    def lookup(self, i: int) -> str:
+        n = ctypes.c_int32()
+        p = _lib.crdt_lookup(self._h, i, ctypes.byref(n))
+        if n.value < 0:
+            raise IndexError(i)
+        return ctypes.string_at(p, n.value).decode()
+
+    def __len__(self) -> int:
+        return _lib.crdt_interner_size(self._h)
+
+    def __contains__(self, s: str) -> bool:
+        b = s.encode()
+        return _lib.crdt_interner_find(self._h, b, len(b)) >= 0
+
+
+def parse_go_int(s: str):
+    """Native twin of utils.intern.parse_go_int."""
+    assert _lib is not None
+    b = s.encode()
+    out = ctypes.c_int32()
+    if _lib.crdt_parse_go_int(b, len(b), ctypes.byref(out)):
+        return out.value
+    return None
+
+
+class OpBatchPacker:
+    """Accumulates (ts, rid, seq, key_str, val_str) op rows in C++ and
+    exposes the packed SoA columns as numpy arrays (copied out on take)."""
+
+    def __init__(self, keys: NativeInterner, vals: NativeInterner):
+        assert _lib is not None, "native runtime unavailable"
+        self.keys, self.vals = keys, vals
+        self._h = _lib.crdt_batch_new()
+
+    def __del__(self):
+        if _lib is not None and getattr(self, "_h", None):
+            _lib.crdt_batch_free(self._h)
+            self._h = None
+
+    def add(self, ts: int, rid: int, seq: int, key: str, val: str) -> None:
+        kb, vb = key.encode(), val.encode()
+        _lib.crdt_batch_add(
+            self._h, self.keys._h, self.vals._h, ts, rid, seq,
+            kb, len(kb), vb, len(vb),
+        )
+
+    def __len__(self) -> int:
+        return _lib.crdt_batch_size(self._h)
+
+    def take(self) -> dict:
+        n = len(self)
+        cols = {}
+        for name in ("ts", "rid", "seq", "key", "val", "payload"):
+            p = getattr(_lib, f"crdt_batch_{name}")(self._h)
+            cols[name] = np.ctypeslib.as_array(p, shape=(n,)).copy()
+        p = _lib.crdt_batch_is_num(self._h)
+        cols["is_num"] = np.ctypeslib.as_array(p, shape=(n,)).astype(bool)
+        _lib.crdt_batch_clear(self._h)
+        return cols

@@ -1,0 +1,200 @@
+// Native host-ingestion runtime: string interning + op-tensor batch packing.
+//
+// The ingestion path (reference: AddCommand JSON handling + the gossip
+// unmarshal loop, /root/reference/main.go:178-187, 241-256) is host-side
+// string work that sits in front of every device op; Python dict/regex
+// costs dominate at high offered load, so the hot pieces live here:
+//
+//   * Interner  — open-addressing FNV-1a hash table, string <-> dense id,
+//                 arena-backed storage (ids are stable, lookups O(1));
+//   * GoInt     — exact strconv.Atoi semantics (sign + decimal digits,
+//                 int32-bounded to match the device dtype policy);
+//   * OpBatch   — SoA int32 columns (ts, rid, seq, key, val, payload,
+//                 is_num) ready to wrap as numpy arrays zero-copy.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Arena {
+  std::vector<char> data;
+  std::vector<uint32_t> offsets;  // id -> offset; length from next offset
+  std::vector<uint32_t> lengths;
+
+  uint32_t add(const char* s, uint32_t len) {
+    offsets.push_back(static_cast<uint32_t>(data.size()));
+    lengths.push_back(len);
+    data.insert(data.end(), s, s + len);
+    return static_cast<uint32_t>(offsets.size() - 1);
+  }
+};
+
+uint64_t fnv1a(const char* s, uint32_t len) {
+  uint64_t h = 1469598103934665603ull;
+  for (uint32_t i = 0; i < len; ++i) {
+    h ^= static_cast<unsigned char>(s[i]);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+struct Interner {
+  // open addressing, power-of-two capacity; slot stores id+1 (0 = empty)
+  std::vector<uint32_t> slots;
+  Arena arena;
+  size_t n = 0;
+
+  Interner() : slots(1024, 0) {}
+
+  void grow() {
+    std::vector<uint32_t> old;
+    old.swap(slots);
+    slots.assign(old.size() * 2, 0);
+    for (uint32_t s1 : old) {
+      if (!s1) continue;
+      uint32_t id = s1 - 1;
+      place(arena.data.data() + arena.offsets[id], arena.lengths[id], id);
+    }
+  }
+
+  void place(const char* s, uint32_t len, uint32_t id) {
+    size_t mask = slots.size() - 1;
+    size_t i = fnv1a(s, len) & mask;
+    while (slots[i]) i = (i + 1) & mask;
+    slots[i] = id + 1;
+  }
+
+  // read-only probe: id or -1, never inserts
+  int32_t find(const char* s, uint32_t len) const {
+    size_t mask = slots.size() - 1;
+    size_t i = fnv1a(s, len) & mask;
+    while (slots[i]) {
+      uint32_t id = slots[i] - 1;
+      if (arena.lengths[id] == len &&
+          std::memcmp(arena.data.data() + arena.offsets[id], s, len) == 0) {
+        return static_cast<int32_t>(id);
+      }
+      i = (i + 1) & mask;
+    }
+    return -1;
+  }
+
+  int32_t intern(const char* s, uint32_t len) {
+    if (n * 2 >= slots.size()) grow();
+    size_t mask = slots.size() - 1;
+    size_t i = fnv1a(s, len) & mask;
+    while (slots[i]) {
+      uint32_t id = slots[i] - 1;
+      if (arena.lengths[id] == len &&
+          std::memcmp(arena.data.data() + arena.offsets[id], s, len) == 0) {
+        return static_cast<int32_t>(id);
+      }
+      i = (i + 1) & mask;
+    }
+    uint32_t id = arena.add(s, len);
+    slots[i] = id + 1;
+    ++n;
+    return static_cast<int32_t>(id);
+  }
+};
+
+// Go strconv.Atoi, bounded to int32 (crdt_tpu.utils.intern.parse_go_int).
+bool parse_go_int(const char* s, uint32_t len, int32_t* out) {
+  if (len == 0) return false;
+  uint32_t i = 0;
+  bool neg = false;
+  if (s[0] == '+' || s[0] == '-') {
+    neg = s[0] == '-';
+    if (len == 1) return false;
+    i = 1;
+  }
+  int64_t v = 0;
+  for (; i < len; ++i) {
+    if (s[i] < '0' || s[i] > '9') return false;
+    v = v * 10 + (s[i] - '0');
+    if (v > (1ll << 40)) return false;  // early overflow cut, exact below
+  }
+  if (neg) v = -v;
+  if (v < INT32_MIN || v > INT32_MAX) return false;
+  *out = static_cast<int32_t>(v);
+  return true;
+}
+
+struct OpBatch {
+  std::vector<int32_t> ts, rid, seq, key, val, payload;
+  std::vector<uint8_t> is_num;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* crdt_interner_new() { return new Interner(); }
+void crdt_interner_free(void* p) { delete static_cast<Interner*>(p); }
+int32_t crdt_intern(void* p, const char* s, int32_t len) {
+  return static_cast<Interner*>(p)->intern(s, static_cast<uint32_t>(len));
+}
+int32_t crdt_interner_size(void* p) {
+  return static_cast<int32_t>(static_cast<Interner*>(p)->n);
+}
+int32_t crdt_interner_find(void* p, const char* s, int32_t len) {
+  return static_cast<Interner*>(p)->find(s, static_cast<uint32_t>(len));
+}
+// Returns pointer into the arena (valid until the next grow-free op: the
+// arena never relocates per-string data, only appends).
+const char* crdt_lookup(void* p, int32_t id, int32_t* len_out) {
+  Interner* t = static_cast<Interner*>(p);
+  if (id < 0 || static_cast<size_t>(id) >= t->arena.offsets.size()) {
+    *len_out = -1;
+    return nullptr;
+  }
+  *len_out = static_cast<int32_t>(t->arena.lengths[id]);
+  return t->arena.data.data() + t->arena.offsets[id];
+}
+
+int32_t crdt_parse_go_int(const char* s, int32_t len, int32_t* out) {
+  return parse_go_int(s, static_cast<uint32_t>(len), out) ? 1 : 0;
+}
+
+void* crdt_batch_new() { return new OpBatch(); }
+void crdt_batch_free(void* p) { delete static_cast<OpBatch*>(p); }
+void crdt_batch_clear(void* p) {
+  OpBatch* b = static_cast<OpBatch*>(p);
+  b->ts.clear(); b->rid.clear(); b->seq.clear(); b->key.clear();
+  b->val.clear(); b->payload.clear(); b->is_num.clear();
+}
+
+// Append one (key, value) op row: interns both strings, parses the value.
+void crdt_batch_add(void* batch, void* keys_interner, void* vals_interner,
+                    int32_t ts, int32_t rid, int32_t seq,
+                    const char* k, int32_t klen,
+                    const char* v, int32_t vlen) {
+  OpBatch* b = static_cast<OpBatch*>(batch);
+  b->ts.push_back(ts);
+  b->rid.push_back(rid);
+  b->seq.push_back(seq);
+  b->key.push_back(crdt_intern(keys_interner, k, klen));
+  b->payload.push_back(crdt_intern(vals_interner, v, vlen));
+  int32_t num = 0;
+  bool ok = parse_go_int(v, static_cast<uint32_t>(vlen), &num);
+  b->val.push_back(ok ? num : 0);
+  b->is_num.push_back(ok ? 1 : 0);
+}
+
+int32_t crdt_batch_size(void* p) {
+  return static_cast<int32_t>(static_cast<OpBatch*>(p)->ts.size());
+}
+// Column accessors (zero-copy views; valid until the next add/clear/free).
+int32_t* crdt_batch_ts(void* p) { return static_cast<OpBatch*>(p)->ts.data(); }
+int32_t* crdt_batch_rid(void* p) { return static_cast<OpBatch*>(p)->rid.data(); }
+int32_t* crdt_batch_seq(void* p) { return static_cast<OpBatch*>(p)->seq.data(); }
+int32_t* crdt_batch_key(void* p) { return static_cast<OpBatch*>(p)->key.data(); }
+int32_t* crdt_batch_val(void* p) { return static_cast<OpBatch*>(p)->val.data(); }
+int32_t* crdt_batch_payload(void* p) { return static_cast<OpBatch*>(p)->payload.data(); }
+uint8_t* crdt_batch_is_num(void* p) { return static_cast<OpBatch*>(p)->is_num.data(); }
+
+}  // extern "C"

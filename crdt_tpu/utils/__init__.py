@@ -1,1 +1,1 @@
-from crdt_tpu.utils import clock, constants, intern  # noqa: F401
+from crdt_tpu.utils import clock, config, constants, intern, metrics  # noqa: F401

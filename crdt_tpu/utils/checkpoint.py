@@ -1,0 +1,109 @@
+"""Checkpoint / resume — a capability the reference lacks entirely (all
+state is in-memory; a crashed replica re-converges from peers via gossip,
+SURVEY.md §5).  Both recovery paths exist here:
+
+* gossip catch-up (free: one full-state join, crdt_tpu.parallel.swarm);
+* durable snapshots of the array state + host interner tables, via orbax
+  when available and a numpy .npz fallback otherwise.
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _interner_dump(interner) -> list:
+    return [interner.lookup(i) for i in range(len(interner))]
+
+
+def _interner_load(strings: list, interner) -> None:
+    for s in strings:
+        interner.intern(s)
+
+
+def save_node(path: str, node) -> None:
+    """Snapshot a ReplicaNode: op-tensor columns + interner tables + the
+    raw command map (the gossip-serving source of truth)."""
+    p = pathlib.Path(path)
+    p.mkdir(parents=True, exist_ok=True)
+    cols = {
+        name: np.asarray(getattr(node.log, name))
+        for name in ("ts", "rid", "seq", "key", "val", "payload", "is_num")
+    }
+    np.savez_compressed(p / "log.npz", **cols)
+    meta = {
+        "rid": node.rid,
+        "alive": node.alive,
+        "seq": node._seq.count,
+        "epoch_ms": node.clock.epoch_ms,
+        "keys": _interner_dump(node.keys),
+        "values": _interner_dump(node.values),
+        "commands": [
+            {"ts": k[0], "rid": k[1], "seq": k[2], "cmd": v}
+            for k, v in node._commands.items()
+        ],
+    }
+    (p / "meta.json").write_text(json.dumps(meta))
+
+
+def restore_node(path: str, node) -> None:
+    """Restore a snapshot into a freshly-constructed ReplicaNode."""
+    from crdt_tpu.models import oplog as oplog_mod
+    import jax.numpy as jnp
+
+    p = pathlib.Path(path)
+    meta = json.loads((p / "meta.json").read_text())
+    assert meta["rid"] == node.rid, "snapshot belongs to another replica"
+    _interner_load(meta["keys"], node.keys)
+    _interner_load(meta["values"], node.values)
+    with np.load(p / "log.npz") as z:
+        node.log = oplog_mod.OpLog(
+            ts=jnp.asarray(z["ts"]), rid=jnp.asarray(z["rid"]),
+            seq=jnp.asarray(z["seq"]), key=jnp.asarray(z["key"]),
+            val=jnp.asarray(z["val"]), payload=jnp.asarray(z["payload"]),
+            is_num=jnp.asarray(z["is_num"]),
+        )
+    node.alive = meta["alive"]
+    node._seq.count = meta["seq"]
+    node.clock.epoch_ms = meta["epoch_ms"]
+    node._commands = {
+        (c["ts"], c["rid"], c["seq"]): c["cmd"] for c in meta["commands"]
+    }
+
+
+def save_swarm(path: str, state: Any) -> None:
+    """Snapshot any stacked swarm state pytree (orbax if present, else npz)."""
+    p = pathlib.Path(path)
+    p.mkdir(parents=True, exist_ok=True)
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save((p / "orbax").resolve(), state, force=True)
+        ckptr.wait_until_finished()
+    except Exception:
+        leaves, treedef = jax.tree.flatten(state)
+        np.savez_compressed(
+            p / "swarm.npz", **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+        )
+        (p / "treedef.json").write_text(str(treedef))
+
+
+def restore_swarm(path: str, like: Any) -> Any:
+    """Restore a swarm snapshot; `like` provides the pytree structure."""
+    p = pathlib.Path(path)
+    if (p / "orbax").exists():
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore((p / "orbax").resolve(), target=like)
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(like)
+    with np.load(p / "swarm.npz") as z:
+        new_leaves = [jnp.asarray(z[f"leaf_{i}"]) for i in range(len(leaves))]
+    return jax.tree.unflatten(treedef, new_leaves)

@@ -9,7 +9,6 @@ identity (ts, rid, seq) is unique at any rate.
 """
 from __future__ import annotations
 
-import itertools
 import time
 
 
@@ -40,10 +39,14 @@ class ManualClock(HostClock):
 
 
 class SeqGen:
-    """Per-replica monotone sequence numbers (op identity tiebreak)."""
+    """Per-replica monotone sequence numbers (op identity tiebreak).
+    `count` is readable/settable so checkpoints can persist it — losing it
+    would let a restored node mint an already-used (ts, rid, seq)."""
 
     def __init__(self, start: int = 0):
-        self._it = itertools.count(start)
+        self.count = start
 
     def next(self) -> int:
-        return next(self._it)
+        n = self.count
+        self.count += 1
+        return n

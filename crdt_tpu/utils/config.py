@@ -1,0 +1,43 @@
+"""Configuration surface — every constant the reference hardcodes, lifted
+into one dataclass (SURVEY.md §5 "Config / flag system: absent").
+
+Defaults reproduce the reference deployment exactly:
+/root/reference/main.go:319 (5 replicas @ 8080-8084), main.go:220-222
+(friend list 8080-8089, including self and five never-started ports),
+main.go:229 (1500 ms gossip period), main.go:280 (300 ms write period),
+main.go:274-276 (62-char key alphabet, deltas in [-20, -11]),
+main.go:320 (300 ms bootstrap stagger), main.go:267 (localhost listen).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+
+ALPHABET = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ1234567890"
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    n_replicas: int = 5
+    base_port: int = 8080
+    friend_range: int = 10          # friends = base_port .. base_port+range-1
+    gossip_period_ms: int = 1500
+    write_period_ms: int = 300
+    bootstrap_stagger_ms: int = 300
+    host: str = "localhost"
+    key_alphabet: str = ALPHABET
+    delta_min: int = -20            # rand.Intn(10) + 2*(-10) ∈ [-20, -11]
+    delta_max: int = -11
+    log_capacity: int = 1024        # per-replica op-tensor capacity (grows 2x)
+    seed: int = 0
+    # reference-faithful gossip topology: friend list includes self and
+    # friend_range - n_replicas dead ports (quirk §0.1.9); False gives the
+    # fixed uniform-live-peer topology
+    reference_topology: bool = False
+
+    def ports(self) -> List[int]:
+        return [self.base_port + i for i in range(self.n_replicas)]
+
+    def friend_ports(self) -> List[int]:
+        return [self.base_port + i for i in range(self.friend_range)]

@@ -1,0 +1,72 @@
+"""Observability: merge/gossip counters and latency percentiles.
+
+The reference's only observability is gin's request log (SURVEY.md §5);
+BASELINE.md asks for merges/sec and p50 merge latency, so those are
+first-class here.  `jax.profiler` tracing hooks live in utils.tracing.
+"""
+from __future__ import annotations
+
+import collections
+import statistics
+import threading
+import time
+from typing import Dict
+
+
+class Metrics:
+    """Thread-safe counters + latency reservoirs (host-side; device work is
+    measured around block_until_ready boundaries by callers)."""
+
+    def __init__(self, reservoir: int = 4096):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = collections.defaultdict(int)
+        self._lat: Dict[str, collections.deque] = collections.defaultdict(
+            lambda: collections.deque(maxlen=reservoir)
+        )
+        self._t0 = time.perf_counter()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] += n
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._lat[name].append(seconds)
+            self._counts[name] += 1
+
+    class _Timer:
+        def __init__(self, m: "Metrics", name: str):
+            self.m, self.name = m, name
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.m.observe(self.name, time.perf_counter() - self.t0)
+
+    def timer(self, name: str) -> "_Timer":
+        return self._Timer(self, name)
+
+    def rate(self, name: str) -> float:
+        with self._lock:
+            return self._counts[name] / max(time.perf_counter() - self._t0, 1e-9)
+
+    def p50(self, name: str) -> float:
+        with self._lock:
+            lat = list(self._lat[name])
+        return statistics.median(lat) if lat else float("nan")
+
+    def quantile(self, name: str, q: float) -> float:
+        with self._lock:
+            lat = sorted(self._lat[name])
+        if not lat:
+            return float("nan")
+        return lat[min(int(q * len(lat)), len(lat) - 1)]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._counts)
+        for name in list(self._lat):
+            out[f"{name}_p50_ms"] = round(self.p50(name) * 1e3, 3)
+        return out

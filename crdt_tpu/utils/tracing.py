@@ -1,0 +1,39 @@
+"""Profiling / tracing hooks (SURVEY.md §5: the reference has none; here
+jax.profiler is first-class for the device path, wall timers for the host).
+
+Usage:
+    with trace_region("gossip_round"):
+        swarm = gossip_round(...)
+or start_trace(logdir)/stop_trace() around a soak run, then inspect with
+TensorBoard's profile plugin or xprof.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def start_trace(logdir: str) -> None:
+    jax.profiler.start_trace(logdir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace_region(name: str):
+    """Named region visible in device traces (TraceAnnotation) — cheap
+    enough to wrap every merge/gossip call."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def trace_to(logdir: str):
+    start_trace(logdir)
+    try:
+        yield
+    finally:
+        stop_trace()

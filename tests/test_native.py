@@ -1,0 +1,84 @@
+"""Native ingestion runtime: must agree exactly with the pure-Python path."""
+import numpy as np
+import pytest
+
+from crdt_tpu import native
+from crdt_tpu.utils import intern as py_intern
+
+pytestmark = pytest.mark.skipif(
+    not native.AVAILABLE, reason="native toolchain unavailable"
+)
+
+
+def test_interner_matches_python():
+    ni = native.NativeInterner()
+    pi = py_intern.Interner()
+    words = ["a", "bb", "a", "", "ccc", "bb", "é", "a" * 1000] + [
+        f"k{i}" for i in range(3000)  # force table growth
+    ]
+    for w in words:
+        assert ni.intern(w) == pi.intern(w), w
+    assert len(ni) == len(pi)
+    for i in range(len(pi)):
+        assert ni.lookup(i) == pi.lookup(i)
+
+
+def test_parse_go_int_matches_python():
+    cases = ["42", "-13", "+7", "007", "", " 1", "1 ", "1_0", "0x10", "1.5",
+             "abc", "--1", "+", "2147483647", "2147483648", "-2147483648",
+             "-2147483649", "0", "-0", "99999999999999999999"]
+    for s in cases:
+        assert native.parse_go_int(s) == py_intern.parse_go_int(s), s
+
+
+def test_batch_packer_matches_encode_value():
+    keys_n, vals_n = native.NativeInterner(), native.NativeInterner()
+    keys_p, vals_p = py_intern.Interner(), py_intern.Interner()
+    packer = native.OpBatchPacker(keys_n, vals_n)
+
+    rows = [
+        (10, 0, 0, "x", "5"),
+        (11, 1, 0, "y", "hello"),
+        (11, 1, 1, "x", "-20"),
+        (12, 2, 0, "z", "007"),
+    ]
+    expect = {n: [] for n in ("ts", "rid", "seq", "key", "val", "payload", "is_num")}
+    for ts, rid, seq, k, v in rows:
+        packer.add(ts, rid, seq, k, v)
+        val, payload, is_num = py_intern.encode_value(v, vals_p)
+        expect["ts"].append(ts)
+        expect["rid"].append(rid)
+        expect["seq"].append(seq)
+        expect["key"].append(keys_p.intern(k))
+        expect["val"].append(val)
+        expect["payload"].append(payload)
+        expect["is_num"].append(is_num)
+
+    got = packer.take()
+    assert len(packer) == 0  # take() clears
+    for name, exp in expect.items():
+        assert got[name].tolist() == exp, name
+    # interned tables agree with the python interner
+    assert [keys_n.lookup(i) for i in range(len(keys_n))] == [
+        keys_p.lookup(i) for i in range(len(keys_p))
+    ]
+
+
+def test_batch_feeds_oplog():
+    from crdt_tpu.models import oplog
+
+    keys, vals = native.NativeInterner(), native.NativeInterner()
+    packer = native.OpBatchPacker(keys, vals)
+    packer.add(1, 0, 0, "k", "5")
+    packer.add(2, 0, 1, "k", "-3")
+    log = oplog.from_ops(8, packer.take())
+    kv = oplog.rebuild(log, n_keys=len(keys))
+    assert oplog.materialize(kv, keys, vals) == {"k": "2"}
+
+
+def test_contains_does_not_mutate():
+    ni = native.NativeInterner()
+    ni.intern("present")
+    assert "present" in ni
+    assert "absent" not in ni
+    assert len(ni) == 1  # probing must not intern
