@@ -16,7 +16,7 @@ import random
 import threading
 from typing import Dict, List, Optional
 
-from crdt_tpu.api.node import ReplicaNode
+from crdt_tpu.api.node import FRONTIER_KEY, SUMMARY_KEY, ReplicaNode
 from crdt_tpu.utils.clock import HostClock
 from crdt_tpu.utils.config import ClusterConfig
 from crdt_tpu.utils.metrics import Metrics
@@ -37,8 +37,16 @@ class LocalCluster:
             for i in range(self.config.n_replicas)
         ]
         self._rng = random.Random(self.config.seed)
+        self._ticks = 0
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # serializes compaction barriers: two racing barriers could compute
+        # frontiers over different alive sets — incomparable, off the chain
+        self._barrier_lock = threading.Lock()
+        # background-gossip failures: recorded here and re-raised by stop().
+        # The reference's gossip goroutine dies silently forever on one bad
+        # payload (quirk §0.1.8); here a dead loop is always surfaced.
+        self.errors: List[Exception] = []
 
     # ---- addressing (reference topology: ports) ----
 
@@ -64,17 +72,70 @@ class LocalCluster:
         if peer is None or peer is node or not peer.alive or not node.alive:
             self.metrics.inc("gossip_skipped")
             return False
-        payload = peer.gossip_payload()
+        since = node.version_vector() if self.config.delta_gossip else None
+        payload = peer.gossip_payload(since=since)
         if payload is None:
             self.metrics.inc("gossip_skipped")
             return False
-        node.receive(payload)
+        if not payload:  # delta mode: peer had nothing we lack — no merge
+            self.metrics.inc("gossip_noop")
+            return False
+        self.metrics.inc(
+            "gossip_payload_ops",
+            sum(1 for k in payload if k not in (FRONTIER_KEY, SUMMARY_KEY)),
+        )
+        fresh = node.receive(payload)
+        if not fresh:  # payload was all re-deliveries (e.g. foreign ops)
+            self.metrics.inc("gossip_noop")
+            return False
         self.metrics.inc("gossip_rounds")
         return True
 
     def tick(self) -> int:
-        """One gossip round for every replica; returns merges performed."""
-        return sum(self.gossip_once(rid) for rid in range(len(self.nodes)))
+        """One gossip round for every replica; returns merges performed.
+        Every config.compact_every-th tick also runs a compaction barrier."""
+        merges = sum(self.gossip_once(rid) for rid in range(len(self.nodes)))
+        self._ticks += 1
+        every = self.config.compact_every
+        if every and self._ticks % every == 0:
+            self.compact()
+        return merges
+
+    def compact(self) -> Dict[int, int]:
+        """One swarm-wide compaction barrier: fold everything every alive
+        node already holds (the stable frontier — elementwise min of alive
+        nodes' version vectors).
+
+        Chain rule: the new barrier must dominate EVERY node's existing
+        frontier, dead nodes included — a dead node's fold has to stay on the
+        frontier chain for its revival merge to be lossless.  If the alive
+        set lacks ops some dead node already folded (that node's summary is
+        the only remaining copy), the barrier is SKIPPED (returns {});
+        barriers resume once the node revives and gossip spreads its fold.
+        Without this rule, a barrier held while the previous frontier's
+        holders are all dead would mint an incomparable frontier generation —
+        wedging revival merges (ValueError) after the raw ops are pruned.
+        """
+        with self._barrier_lock:
+            alive = [n for n in self.nodes if n.alive]
+            if not alive:
+                return {}
+            vvs = [n.version_vector() for n in alive]
+            rids = set().union(*vvs)
+            frontier = {
+                r: s
+                for r in rids
+                if (s := min(vv.get(r, -1) for vv in vvs)) >= 0
+            }
+            for n in self.nodes:
+                for r, s in n.frontier.items():
+                    if frontier.get(r, -1) < s:
+                        self.metrics.inc("compact_skipped")
+                        return {}
+            if frontier:
+                for n in alive:
+                    n.compact(frontier)
+            return frontier
 
     def converged(self) -> bool:
         states = [n.get_state() for n in self.nodes if n.alive]
@@ -97,8 +158,27 @@ class LocalCluster:
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
+        if self.errors:
+            raise RuntimeError(
+                f"{len(self.errors)} background gossip loop(s) died"
+            ) from self.errors[0]
 
     def _loop(self, rid: int) -> None:
+        """Background pull loop for one replica.  Replica 0's loop doubles as
+        the compaction scheduler so config.compact_every works in live mode
+        too (one designated scheduler: barriers must not race each other;
+        racing a barrier against concurrent gossip is safe — the per-node
+        clamp makes the common target frontier valid regardless)."""
         period = self.config.gossip_period_ms / 1000.0
+        rounds = 0
         while not self._stop.wait(period):
-            self.gossip_once(rid)
+            try:
+                self.gossip_once(rid)
+                rounds += 1
+                every = self.config.compact_every
+                if rid == 0 and every and rounds % every == 0:
+                    self.compact()
+            except Exception as e:  # noqa: BLE001 — surfaced via stop()
+                self.metrics.inc("gossip_loop_errors")
+                self.errors.append(e)
+                raise

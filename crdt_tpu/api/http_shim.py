@@ -57,7 +57,23 @@ def _make_handler(cluster: LocalCluster, idx: int):
                 else:
                     self._send(200, json.dumps(state), "application/json")
             elif url.path == "/gossip":
-                payload = self.node.gossip_payload()
+                # ?vv=<json {rid: seq}>: delta gossip — only ops the
+                # requester is missing.  Plain GET /gossip is the
+                # reference's full-log dump (main.go:159) as long as the
+                # node has never compacted; after a fold it carries the
+                # reserved summary sections a Go peer cannot parse
+                since = None
+                q = parse_qs(url.query)
+                if "vv" in q:
+                    try:
+                        since = {
+                            int(r): int(s)
+                            for r, s in json.loads(q["vv"][0]).items()
+                        }
+                    except Exception:
+                        self._send(400, "invalid vv")
+                        return
+                payload = self.node.gossip_payload(since=since)
                 if payload is None:
                     self._send(502, "Unreachable")
                 else:

@@ -20,11 +20,11 @@ is the shared-interner fast path.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from crdt_tpu.models import oplog
+from crdt_tpu.models import compactlog, oplog
 from crdt_tpu.utils.clock import HostClock, SeqGen
 from crdt_tpu.utils.intern import Interner, encode_value
 from crdt_tpu.utils.metrics import Metrics
@@ -36,6 +36,29 @@ from crdt_tpu.utils.metrics import Metrics
 # its own epoch.  Plain integer keys (a Go peer's UnixMilli log keys,
 # main.go:187) are accepted with rid=-1, seq=0.
 INT32_MIN, INT32_MAX = -(2**31), 2**31 - 1
+
+# Reserved payload sections for compaction-aware gossip (delta-CRDT mode,
+# crdt_tpu.models.compactlog).  NOT part of the Go-compatible wire surface: a
+# reference peer would choke on these keys (its malformed-key path kills its
+# gossip loop, quirk §0.1.8) — so compaction stays off (the reference's own
+# behavior: it never prunes, main.go:75) unless the deployment opts in via
+# ClusterConfig.compact_every / explicit compact() calls.
+FRONTIER_KEY = "__frontier__"
+SUMMARY_KEY = "__summary__"
+
+
+def _summary_entry(e: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize one wire-shaped summary entry (the single schema definition
+    — used by payload adoption and by the device-summary decoder)."""
+    return {
+        "num": int(e["num"]),
+        "num_count": int(e["num_count"]),
+        "ts": int(e["ts"]),
+        "rid": int(e["rid"]),
+        "seq": int(e["seq"]),
+        "payload": str(e["payload"]),
+        "is_num": bool(e["is_num"]),
+    }
 
 
 def _wire_key(ts_abs: int, rid: int, seq: int) -> str:
@@ -81,6 +104,24 @@ class ReplicaNode:
         # host copy of raw commands per op, for gossip serving:
         # (ts, rid, seq) -> {key: value}
         self._commands: Dict[Tuple[int, int, int], Dict[str, str]] = {}
+        # delta-extraction indexes over _commands (share the same cmd dicts):
+        # per-writer ops in ascending-seq order (seqs are per-writer
+        # contiguous, so "ops after seq s" is a list slice — delta gossip
+        # costs O(delta), not O(total history)), plus watermarkless rid<0
+        # (Go-peer) ops, plus the incremental received watermark.
+        self._by_writer: Dict[int, List[Tuple[Tuple[int, int, int], Dict[str, str]]]] = {}
+        self._foreign: List[Tuple[Tuple[int, int, int], Dict[str, str]]] = []
+        self._vv: Dict[int, int] = {}
+        # compaction state (crdt_tpu.models.compactlog): per-writer folded
+        # watermark + the per-key fold of everything under it.  Summary
+        # entries are wire-shaped: {"num", "num_count", "ts" (absolute ms),
+        # "rid", "seq", "payload" (raw string), "is_num"}.
+        self._frontier: Dict[int, int] = {}
+        self._summary: Dict[str, Dict[str, Any]] = {}
+        # encoded-summary cache: (Summary arrays, key-space size) — the host
+        # summary only changes on compact/adopt, but get_state() needs it as
+        # device arrays every call
+        self._summary_cache: Optional[Tuple[compactlog.Summary, int]] = None
 
     # ---- write path ----
 
@@ -103,36 +144,122 @@ class ReplicaNode:
         if not self.alive:
             return None
         with self._lock:
-            # round the key space up to a power of two: rebuild's n_keys is a
-            # static jit arg, so this bounds recompiles to O(log K) instead of
-            # one per newly-interned key (materialize only reads len(keys))
-            n = 16
-            while n < len(self.keys):
-                n *= 2
-            kv = oplog.rebuild(self.log, n_keys=n)
+            if self._frontier:
+                kv = compactlog.rebuild(self._device_clog())
+            else:
+                kv = oplog.rebuild(self.log, n_keys=self._n_keys())
             return oplog.materialize(kv, self.keys, self.values)
+
+    # round array dims up to powers of two: jit shapes are static, so this
+    # bounds recompiles to O(log n) instead of one per newly-interned key /
+    # newly-seen writer (materialize only reads len(keys))
+    def _n_keys(self) -> int:
+        n = 16
+        while n < len(self.keys):
+            n *= 2
+        return n
+
+    def _n_writers(self) -> int:
+        top = max([self.rid, *self._frontier, *self._vv], default=0)
+        n = 8
+        while n <= top:
+            n *= 2
+        return n
 
     # ---- gossip ----
 
-    def gossip_payload(self) -> Optional[Dict[str, Dict[str, str]]]:
-        """GET /gossip: the full op log as wire JSON (None when down —
-        caller skips, mirroring the 502 path main.go:166-169)."""
+    def version_vector(self) -> Dict[int, int]:
+        """This node's received watermark: writer rid -> max contiguous seq
+        held (folded or raw).  The delta-gossip request token."""
+        with self._lock:
+            return self._version_vector_locked()
+
+    @property
+    def frontier(self) -> Dict[int, int]:
+        """This node's folded watermark (snapshot copy)."""
+        with self._lock:
+            return dict(self._frontier)
+
+    def _version_vector_locked(self) -> Dict[int, int]:
+        vv = dict(self._frontier)
+        for rid, seq in self._vv.items():
+            if seq > vv.get(rid, -1):
+                vv[rid] = seq
+        return vv
+
+    def gossip_payload(
+        self, since: Optional[Dict[int, int]] = None
+    ) -> Optional[Dict[str, Any]]:
+        """GET /gossip: op-log wire JSON (None when down — caller skips,
+        mirroring the 502 path main.go:166-169).
+
+        ``since`` is the requester's version vector: only ops it is missing
+        are included (delta gossip — the reference re-ships its ENTIRE log
+        every round, main.go:159).  When this node has compacted past what
+        ``since`` covers, the payload additionally carries the summary +
+        frontier sections so the requester can adopt the fold.
+
+        Wire-compat notes: (1) rid<0 (Go-format) ops carry no watermark and
+        are re-shipped in every payload — delta extraction is O(delta) only
+        over native ops, so mixed fleets lose the payload bound for the
+        foreign part (receivers dedup them; `receive` reports 0 fresh ops);
+        (2) ``since=None`` returns every *retained* raw op, which is the
+        reference's full-log dump only while this node has never compacted —
+        after a fold the payload necessarily includes the reserved sections,
+        which a Go peer cannot parse (ClusterConfig.compact_every documents
+        the mixed-fleet rule: don't compact).
+        """
         if not self.alive:
             return None
         epoch = self.clock.epoch_ms
         with self._lock:
-            return {
-                _wire_key(k[0] + epoch, k[1], k[2]): dict(v)
-                for k, v in sorted(self._commands.items())
-            }
+            if since is None:
+                # full dump of retained raw ops, ts-sorted like the
+                # reference's treemap JSON (main.go:159); Go-compatible only
+                # while this node has never compacted (see docstring)
+                payload: Dict[str, Any] = {
+                    _wire_key(k[0] + epoch, k[1], k[2]): dict(v)
+                    for k, v in sorted(self._commands.items())
+                }
+            else:
+                # delta: per-writer tail slices — O(|delta|), not O(history)
+                payload = {
+                    _wire_key(k[0] + epoch, k[1], k[2]): dict(v)
+                    for k, v in self._foreign
+                }
+                for w, lst in self._by_writer.items():
+                    if not lst:
+                        continue
+                    start = since.get(w, -1) + 1 - lst[0][0][2]
+                    for k, v in lst[max(start, 0):]:
+                        payload[_wire_key(k[0] + epoch, k[1], k[2])] = dict(v)
+            since = since or {}
+            frontier_covered = all(
+                since.get(r, -1) >= s for r, s in self._frontier.items()
+            )
+            if self._frontier and not frontier_covered:
+                payload[FRONTIER_KEY] = {
+                    str(r): s for r, s in self._frontier.items()
+                }
+                payload[SUMMARY_KEY] = {
+                    k: dict(e) for k, e in self._summary.items()
+                }
+            return payload
 
-    def receive(self, payload: Optional[Dict[str, Dict[str, str]]]) -> None:
-        """Pull-side merge of a peer's gossip payload (main.go:250-257).
+    def receive(self, payload: Optional[Dict[str, Any]]) -> int:
+        """Pull-side merge of a peer's gossip payload (main.go:250-257);
+        returns the number of genuinely new ops absorbed (0 = the payload
+        taught us nothing — re-deliveries and already-folded ops dedup).
         Unknown strings are interned locally; a malformed key raises
         ValueError (the reference silently killed its gossip loop forever,
         quirk §0.1.8 — failing loudly is the fix)."""
         if not payload or not self.alive:
-            return
+            return 0
+        payload = dict(payload)
+        remote_frontier = {
+            int(r): int(s) for r, s in (payload.pop(FRONTIER_KEY, None) or {}).items()
+        }
+        remote_summary = payload.pop(SUMMARY_KEY, None) or {}
         epoch = self.clock.epoch_ms
         rows = []
         for k, cmd in payload.items():
@@ -147,7 +274,12 @@ class ReplicaNode:
             rows.append((ts, rid, seq, cmd))
         with self._lock:
             with self.metrics.timer("merge"):
-                self._ingest(rows)
+                adopted = 0
+                if remote_frontier:
+                    adopted = self._adopt_frontier_locked(
+                        remote_frontier, remote_summary
+                    )
+                return self._ingest(rows) + adopted
 
     # ---- health / fault injection ----
 
@@ -157,31 +289,257 @@ class ReplicaNode:
     def set_alive(self, alive: bool) -> None:
         self.alive = bool(alive)
 
+    # ---- compaction (delta-CRDT log pruning, crdt_tpu.models.compactlog) ----
+
+    def compact(self, frontier: Dict[int, int]) -> None:
+        """Fold every held op at or under ``frontier`` into the summary and
+        prune it from the log + command map.
+
+        ``frontier`` must be swarm-stable (LocalCluster.compact computes the
+        min over alive nodes' version vectors); like the device path it is
+        clamped to this node's own knowledge, so a too-eager frontier cannot
+        drop never-received ops.  The fold itself runs on-device
+        (compactlog.compact) and is decoded back to the wire-shaped host
+        summary — one semantics, two representations.
+        """
+        with self._lock:
+            vv = self._version_vector_locked()
+            target = {
+                r: min(s, vv.get(r, -1))
+                for r, s in frontier.items()
+            }
+            target = {
+                r: s
+                for r, s in target.items()
+                if s > self._frontier.get(r, -1)
+            }
+            if not target:
+                return
+            w = self._n_writers()
+            merged = dict(self._frontier)
+            merged.update(target)
+            folded = compactlog.compact(
+                self._device_clog(n_writers=w),
+                self._frontier_array(merged, w),
+            )
+            self.log = folded.tail
+            self._frontier = merged
+            self._summary = self._decode_summary(folded.summary)
+            self._summary_cache = (folded.summary, folded.summary.num.shape[-1])
+            self._prune_commands_locked()
+            self.metrics.inc("compactions")
+
+    def _adopt_frontier_locked(
+        self, remote_frontier: Dict[int, int], remote_summary: Dict[str, Any]
+    ) -> int:
+        """Adopt a further-ahead peer's fold (the chain rule of
+        compactlog.merge on the wire); returns 1 if the frontier advanced.
+        Frontiers advance only through swarm-stable barriers, so two live
+        frontiers are always comparable; incomparable ones mean a
+        mis-deployed cluster and fail loudly."""
+        rids = set(self._frontier) | set(remote_frontier)
+        own_geq = all(
+            self._frontier.get(r, -1) >= remote_frontier.get(r, -1)
+            for r in rids
+        )
+        if own_geq:
+            return 0  # our fold covers theirs; their ops filter via _ingest
+        remote_geq = all(
+            remote_frontier.get(r, -1) >= self._frontier.get(r, -1)
+            for r in rids
+        )
+        if not remote_geq:
+            raise ValueError(
+                f"incomparable compaction frontiers (ours {self._frontier}, "
+                f"remote {remote_frontier}): frontiers must advance through "
+                "swarm-stable barriers (chain rule)"
+            )
+        # A non-trivial frontier always folds >=1 op, and every folded op
+        # contributes a key — an empty/missing summary can only mean a
+        # truncated or corrupted payload.  Adopting it would silently destroy
+        # the folded state (prune below), so fail loudly instead.
+        if any(s >= 0 for s in remote_frontier.values()) and not remote_summary:
+            raise ValueError(
+                f"frontier {remote_frontier} arrived with an empty/missing "
+                "__summary__ section: refusing to adopt (truncated payload?)"
+            )
+        self._summary = {
+            str(k): _summary_entry(e) for k, e in remote_summary.items()
+        }
+        self._frontier = dict(remote_frontier)
+        self._summary_cache = None
+        for r, s in remote_frontier.items():  # summary extends our knowledge
+            if s > self._vv.get(r, -1):
+                self._vv[r] = s
+        # drop now-folded raw rows (they are accounted in the adopted summary)
+        w = self._n_writers()
+        self.log = oplog.delta_since(
+            self.log, self._frontier_array(self._frontier, w)
+        )
+        self._prune_commands_locked()
+        self.metrics.inc("frontier_adoptions")
+        return 1
+
+    def _prune_commands_locked(self) -> None:
+        f = self._frontier
+        self._commands = {
+            k: v
+            for k, v in self._commands.items()
+            if not (k[1] >= 0 and k[2] <= f.get(k[1], -1))
+        }
+        for w, lst in self._by_writer.items():
+            cut = f.get(w, -1)
+            if lst and lst[0][0][2] <= cut:
+                self._by_writer[w] = [e for e in lst if e[0][2] > cut]
+
+    def _rebuild_indexes_locked(self) -> None:
+        """Recompute the delta indexes from _commands + frontier (snapshot
+        restore path, crdt_tpu.utils.checkpoint.restore_node)."""
+        self._by_writer = {}
+        self._foreign = []
+        self._vv = {}
+        self._summary_cache = None
+        for ident in sorted(self._commands, key=lambda k: (k[1], k[2], k[0])):
+            stored = self._commands[ident]
+            rid, seq = ident[1], ident[2]
+            if rid >= 0:
+                self._by_writer.setdefault(rid, []).append((ident, stored))
+                if seq > self._vv.get(rid, -1):
+                    self._vv[rid] = seq
+            else:
+                self._foreign.append((ident, stored))
+        for r, s in self._frontier.items():
+            if s > self._vv.get(r, -1):
+                self._vv[r] = s
+
+    def _frontier_array(self, frontier: Dict[int, int], n_writers: int):
+        import jax.numpy as jnp
+
+        arr = np.full((n_writers,), -1, np.int32)
+        for r, s in frontier.items():
+            if 0 <= r < n_writers:
+                arr[r] = s
+        return jnp.asarray(arr)
+
+    def _device_clog(self, n_writers: Optional[int] = None) -> compactlog.CompactedLog:
+        """The device view of this node's full state: host summary + frontier
+        encoded as arrays over the current interned key space, tail = log."""
+        import jax.numpy as jnp
+
+        # intern summary strings BEFORE sizing the key space: an adopted
+        # summary can mention keys this node never saw as raw ops
+        for key_str, e in self._summary.items():
+            self.keys.intern(key_str)
+            self.values.intern(e["payload"])
+        k = self._n_keys()
+        w = n_writers or self._n_writers()
+        epoch = self.clock.epoch_ms
+        if self._summary_cache is not None and self._summary_cache[1] == k:
+            return compactlog.CompactedLog(
+                summary=self._summary_cache[0],
+                frontier=self._frontier_array(self._frontier, w),
+                tail=self.log,
+            )
+        s = compactlog.empty_summary(k)
+        if self._summary:
+            cols = {
+                n: np.array(getattr(s, n))  # np.array: writable copy
+                for n in ("present", "num", "num_count", "ts", "rid", "seq",
+                          "payload", "is_num")
+            }
+            for key_str, e in self._summary.items():
+                i = self.keys.intern(key_str)
+                ts = e["ts"] - epoch
+                if not (INT32_MIN <= ts <= INT32_MAX):
+                    raise ValueError(
+                        f"summary timestamp {e['ts']} outside this node's "
+                        f"int32 window (epoch {epoch})"
+                    )
+                cols["present"][i] = True
+                cols["num"][i] = e["num"]
+                cols["num_count"][i] = e["num_count"]
+                cols["ts"][i] = ts
+                cols["rid"][i] = e["rid"]
+                cols["seq"][i] = e["seq"]
+                cols["payload"][i] = self.values.intern(e["payload"])
+                cols["is_num"][i] = e["is_num"]
+            s = compactlog.Summary(**{n: jnp.asarray(c) for n, c in cols.items()})
+        self._summary_cache = (s, k)
+        return compactlog.CompactedLog(
+            summary=s,
+            frontier=self._frontier_array(self._frontier, w),
+            tail=self.log,
+        )
+
+    def _decode_summary(self, s: compactlog.Summary) -> Dict[str, Dict[str, Any]]:
+        epoch = self.clock.epoch_ms
+        present = np.asarray(s.present)
+        num = np.asarray(s.num)
+        num_count = np.asarray(s.num_count)
+        ts = np.asarray(s.ts)
+        rid = np.asarray(s.rid)
+        seq = np.asarray(s.seq)
+        payload = np.asarray(s.payload)
+        is_num = np.asarray(s.is_num)
+        out: Dict[str, Dict[str, Any]] = {}
+        for i in range(len(self.keys)):
+            if not present[i]:
+                continue
+            out[self.keys.lookup(i)] = _summary_entry({
+                "num": num[i],
+                "num_count": num_count[i],
+                "ts": int(ts[i]) + epoch,
+                "rid": rid[i],
+                "seq": seq[i],
+                "payload": self.values.lookup(int(payload[i])),
+                "is_num": is_num[i],
+            })
+        return out
+
     # ---- internals ----
 
-    def _ingest(self, rows: List[Tuple[int, int, int, Dict[str, str]]]) -> None:
-        """Append/merge op rows (caller holds the lock).  Grows the log
-        (2x) instead of silently dropping ops at capacity overflow."""
+    def _accept(self, rows) -> List[Tuple[int, int, int, Dict[str, str]]]:
+        """Filter duplicate / already-folded rows, record the survivors in
+        the command map + delta indexes, and return them.  Rows are taken in
+        (rid, seq) order so each writer's index list stays seq-ascending
+        (per-writer prefixes are contiguous, so a later batch's seqs always
+        extend the list)."""
+        accepted = []
+        f = self._frontier
+        for ts, rid, seq, cmd in sorted(rows, key=lambda r: (r[1], r[2], r[0])):
+            ident = (ts, rid, seq)
+            if ident in self._commands:
+                continue  # duplicate op (gossip re-delivery): union no-op
+            if rid >= 0 and seq <= f.get(rid, -1):
+                continue  # already folded into the summary
+            stored = dict(cmd)
+            self._commands[ident] = stored
+            if rid >= 0:
+                self._by_writer.setdefault(rid, []).append((ident, stored))
+                if seq > self._vv.get(rid, -1):
+                    self._vv[rid] = seq
+            else:
+                self._foreign.append((ident, stored))
+            accepted.append((ts, rid, seq, stored))
+        return accepted
+
+    def _ingest(self, rows: List[Tuple[int, int, int, Dict[str, str]]]) -> int:
+        """Append/merge op rows (caller holds the lock); returns how many
+        genuinely new ops landed.  Grows the log (2x) instead of silently
+        dropping ops at capacity overflow."""
         fresh = 0
+        accepted = self._accept(rows)
         if self._packer is not None:  # native packing path
-            for ts, rid, seq, cmd in rows:
-                ident = (ts, rid, seq)
-                if ident in self._commands:
-                    continue  # duplicate op (gossip re-delivery): union no-op
-                self._commands[ident] = dict(cmd)
+            for ts, rid, seq, cmd in accepted:
                 for k, v in cmd.items():
                     self._packer.add(ts, rid, seq, k, v)
                     fresh += 1
             if not fresh:
-                return
+                return 0
             ops = self._packer.take()
         else:
             cols = {n: [] for n in ("ts", "rid", "seq", "key", "val", "payload", "is_num")}
-            for ts, rid, seq, cmd in rows:
-                ident = (ts, rid, seq)
-                if ident in self._commands:
-                    continue
-                self._commands[ident] = dict(cmd)
+            for ts, rid, seq, cmd in accepted:
                 for k, v in cmd.items():
                     val, payload, is_num = encode_value(v, self.values)
                     cols["ts"].append(ts)
@@ -193,7 +551,7 @@ class ReplicaNode:
                     cols["is_num"].append(is_num)
                     fresh += 1
             if not fresh:
-                return
+                return 0
             ops = {
                 n: np.asarray(c, bool if n == "is_num" else np.int32)
                 for n, c in cols.items()
@@ -208,6 +566,7 @@ class ReplicaNode:
         assert int(n_unique) <= self.log.capacity
         self.log = merged
         self.metrics.inc("ops_ingested", fresh)
+        return fresh
 
     def _grow(self) -> None:
         bigger = oplog.empty(self.log.capacity * 2)

@@ -1,1 +1,1 @@
-from crdt_tpu.models import gcounter, pncounter, lww, orset, oplog  # noqa: F401
+from crdt_tpu.models import gcounter, pncounter, lww, orset, oplog, compactlog  # noqa: F401

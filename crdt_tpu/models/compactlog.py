@@ -1,0 +1,245 @@
+"""CompactedLog: an OpLog with its stable prefix folded into a per-key
+summary — bounding the reference's unbounded log growth.
+
+The reference never prunes its op log (/root/reference/main.go:75 clears only
+the staging buffer) and gossips the full log every round (main.go:159), so
+both memory and per-round merge cost grow without bound (SURVEY.md §6).  The
+TPU-native fix is delta-CRDT log compaction coordinated by a *stable
+frontier*:
+
+* a replica's knowledge is summarized by a per-writer version vector
+  (crdt_tpu.models.oplog.version_vector);
+* the swarm's **stable frontier** is the elementwise min of the alive
+  replicas' vectors — every op at or below it is held by every alive replica
+  (crdt_tpu.parallel.swarm.stable_frontier);
+* each replica deterministically folds exactly that stable op set into a
+  fixed-shape per-key ``Summary`` and drops the raw rows; the remaining
+  ``tail`` holds only unstable ops, so steady-state log size tracks the
+  gossip lag, not total history.
+
+Correctness rests on two invariants, both enforced by construction:
+
+1. **Determinism** — folding a given op set yields one canonical Summary, so
+   replicas that folded the same frontier have structurally equal summaries.
+2. **Chain frontiers** — frontiers only advance to swarm-agreed values
+   (compaction_round), so any two live frontiers are comparable (one covers
+   the other).  ``merge`` exploits this: adopt the larger frontier's summary
+   verbatim and drop both tails' rows under it (they are folded in already).
+   A replica that was dead during a barrier is simply behind on the chain;
+   one merge catches it up — ops below the frontier that it uniquely holds
+   cannot exist (the frontier minimizes over what every alive replica had
+   received, and its own unsent writes have seqs above its own watermark).
+
+``rebuild`` over (summary, tail) equals ``oplog.rebuild`` over the
+uncompacted log — the compaction-transparency property tested in
+tests/test_compactlog.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from crdt_tpu.models import oplog
+from crdt_tpu.utils.constants import SENTINEL, TS_NULL
+
+
+@struct.dataclass
+class Summary:
+    """Deterministic per-key fold of the stable op set (interned key space of
+    size K).  ``ts/rid/seq/payload/is_num`` describe the lexicographically
+    newest folded op per key (valid iff ``present``); ``num/num_count``
+    accumulate every folded numeric delta — together exactly the per-key
+    facts oplog.rebuild extracts, so folded rows can be discarded."""
+
+    present: jax.Array    # bool[K]  any folded op for this key
+    num: jax.Array        # int32[K] sum of folded numeric deltas
+    num_count: jax.Array  # int32[K] count of folded numeric ops
+    ts: jax.Array         # int32[K] newest folded op identity…
+    rid: jax.Array        # int32[K]
+    seq: jax.Array        # int32[K]
+    payload: jax.Array    # int32[K] …its raw-value intern id
+    is_num: jax.Array     # bool[K]  …whether it parses as an integer
+
+
+@struct.dataclass
+class CompactedLog:
+    summary: Summary      # fold of every op covered by `frontier`
+    frontier: jax.Array   # int32[W] per-writer max folded seq (-1 = none)
+    tail: oplog.OpLog     # ops beyond the frontier (sorted, padded)
+
+    @property
+    def capacity(self) -> int:
+        return self.tail.capacity
+
+    @property
+    def n_keys(self) -> int:
+        return self.summary.num.shape[-1]
+
+    @property
+    def n_writers(self) -> int:
+        return self.frontier.shape[-1]
+
+
+def empty_summary(n_keys: int) -> Summary:
+    z = jnp.zeros((n_keys,), jnp.int32)
+    return Summary(
+        present=jnp.zeros((n_keys,), bool),
+        num=z, num_count=z,
+        ts=jnp.full((n_keys,), TS_NULL, jnp.int32),
+        rid=jnp.full((n_keys,), -1, jnp.int32),
+        seq=jnp.full((n_keys,), -1, jnp.int32),
+        payload=z,
+        is_num=jnp.zeros((n_keys,), bool),
+    )
+
+
+def empty(capacity: int, n_keys: int, n_writers: int) -> CompactedLog:
+    return CompactedLog(
+        summary=empty_summary(n_keys),
+        frontier=jnp.full((n_writers,), -1, jnp.int32),
+        tail=oplog.empty(capacity),
+    )
+
+
+def fresh(log: oplog.OpLog, n_keys: int, n_writers: int) -> CompactedLog:
+    """Wrap an uncompacted log (frontier = -1: nothing folded yet)."""
+    return CompactedLog(
+        summary=empty_summary(n_keys),
+        frontier=jnp.full((n_writers,), -1, jnp.int32),
+        tail=log,
+    )
+
+
+def size(c: CompactedLog) -> jax.Array:
+    """Live (unfolded) rows — the quantity compaction keeps bounded."""
+    return oplog.size(c.tail)
+
+
+def received_vv(c: CompactedLog) -> jax.Array:
+    """This replica's full knowledge watermark: folded ∨ still-raw."""
+    return jnp.maximum(
+        c.frontier, oplog.version_vector(c.tail, c.frontier.shape[-1])
+    )
+
+
+def _lex_gt(a, b):
+    """(ts, rid, seq) lexicographic strictly-greater, elementwise."""
+    return (
+        (a[0] > b[0])
+        | ((a[0] == b[0]) & (a[1] > b[1]))
+        | ((a[0] == b[0]) & (a[1] == b[1]) & (a[2] > b[2]))
+    )
+
+
+@jax.jit
+def merge(a: CompactedLog, b: CompactedLog) -> CompactedLog:
+    """CRDT join of two compacted logs with comparable (chain) frontiers:
+    take the further-ahead side's summary + frontier verbatim, then union the
+    tails with every row at or under the adopted frontier dropped (those rows
+    are already folded into the adopted summary).
+
+    The adopted frontier is the winning SIDE's frontier, not the elementwise
+    max: under the chain precondition they are identical, but if the
+    precondition is ever violated (incomparable frontiers) the elementwise
+    max would drop tail rows that NEITHER summary folded — the winner's own
+    frontier never covers rows outside its summary, so nothing is lost."""
+    a_geq = jnp.all(a.frontier >= b.frontier)
+    frontier = jnp.where(a_geq, a.frontier, b.frontier)
+    summary = jax.tree.map(
+        lambda x, y: jnp.where(a_geq, x, y), a.summary, b.summary
+    )
+    tail = oplog.merge(
+        oplog.delta_since(a.tail, frontier),
+        oplog.delta_since(b.tail, frontier),
+    )
+    return CompactedLog(summary=summary, frontier=frontier, tail=tail)
+
+
+def _fold_tail(tail: oplog.OpLog, mask: jax.Array, n_keys: int):
+    """Per-key facts of the masked tail rows: (has, sums, counts, newest row
+    fields) — one scatter-add pass + one scatter-max pass, no sequential
+    fold (the TPU shape of the reference's newest→oldest walk,
+    /root/reference/main.go:76-98)."""
+    key_safe = jnp.where(mask, tail.key, n_keys)
+    numeric = mask & tail.is_num
+    sums = (
+        jnp.zeros((n_keys + 1,), jnp.int32)
+        .at[key_safe]
+        .add(jnp.where(numeric, tail.val, 0))
+    )[:n_keys]
+    counts = (
+        jnp.zeros((n_keys + 1,), jnp.int32)
+        .at[key_safe]
+        .add(numeric.astype(jnp.int32))
+    )[:n_keys]
+    # Rows are sorted ascending by (ts, rid, seq), so the largest masked row
+    # index per key IS the lexicographically newest masked op.
+    idx = jnp.arange(tail.capacity, dtype=jnp.int32)
+    last = (
+        jnp.full((n_keys + 1,), -1, jnp.int32)
+        .at[key_safe]
+        .max(jnp.where(mask, idx, -1))
+    )[:n_keys]
+    has = last >= 0
+    li = jnp.clip(last, 0)
+    newest = (tail.ts[li], tail.rid[li], tail.seq[li])
+    return has, sums, counts, newest, tail.payload[li], tail.is_num[li]
+
+
+@jax.jit
+def compact(c: CompactedLog, new_frontier: jax.Array) -> CompactedLog:
+    """Advance the compaction frontier: fold every tail row at or under
+    ``new_frontier`` into the summary and drop it from the tail.
+
+    ``new_frontier`` must be a swarm-agreed stable frontier
+    (crdt_tpu.parallel.swarm.stable_frontier) — frontiers must stay
+    chain-ordered across live replicas for merge's adopt-the-larger rule to
+    hold.  As a hard safety net the advance is clamped to this replica's own
+    received watermark: a frontier beyond ops never received would make later
+    merges drop those ops as "already folded" and lose them permanently (for
+    a true stable frontier the clamp is a no-op, since stability means every
+    alive replica already received everything under it).  Observable state is
+    invariant: rebuild(compact(c, f)) == rebuild(c).
+    """
+    s, t = c.summary, c.tail
+    frontier = jnp.maximum(
+        c.frontier, jnp.minimum(new_frontier, received_vv(c))
+    )
+    cov = oplog.covered_by(t, frontier)
+    has, sums, counts, newest, pay, isnum = _fold_tail(t, cov, c.n_keys)
+    newer = has & (~s.present | _lex_gt(newest, (s.ts, s.rid, s.seq)))
+    summary = Summary(
+        present=s.present | has,
+        num=s.num + sums,
+        num_count=s.num_count + counts,
+        ts=jnp.where(newer, newest[0], s.ts),
+        rid=jnp.where(newer, newest[1], s.rid),
+        seq=jnp.where(newer, newest[2], s.seq),
+        payload=jnp.where(newer, pay, s.payload),
+        is_num=jnp.where(newer, isnum, s.is_num),
+    )
+    return CompactedLog(
+        summary=summary, frontier=frontier, tail=oplog.delta_since(t, frontier)
+    )
+
+
+@jax.jit
+def rebuild(c: CompactedLog) -> oplog.KVState:
+    """Materialized view over summary + tail — equal to ``oplog.rebuild`` of
+    the uncompacted log (compaction transparency).  Numeric sums/counts add
+    across the two parts; the mode-deciding newest op is the lexicographic
+    max of the summary's newest and the tail's newest per key."""
+    s, t = c.summary, c.tail
+    valid = t.ts != SENTINEL
+    has, sums, counts, newest, pay, isnum = _fold_tail(t, valid, c.n_keys)
+    tail_newer = has & (~s.present | _lex_gt(newest, (s.ts, s.rid, s.seq)))
+    present = s.present | has
+    newest_is_num = jnp.where(tail_newer, isnum, s.is_num) & present
+    return oplog.KVState(
+        present=present,
+        is_num=newest_is_num,
+        num=jnp.where(newest_is_num, s.num + sums, 0),
+        num_count=s.num_count + counts,
+        payload=jnp.where(present, jnp.where(tail_newer, pay, s.payload), 0),
+    )

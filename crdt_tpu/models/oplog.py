@@ -155,6 +155,68 @@ def merge_checked(local: OpLog, remote: OpLog):
     )
 
 
+@partial(jax.jit, static_argnames="n_writers")
+def version_vector(log: OpLog, n_writers: int) -> jax.Array:
+    """Per-writer received watermark: ``vv[w]`` = max seq of any op authored
+    by writer ``w`` in this log, ``-1`` when none.
+
+    Writer seqs are per-writer contiguous from 0 (crdt_tpu.utils.clock.SeqGen)
+    and every transfer path (full-state gossip, delta gossip, capacity-
+    overflow drop of the globally newest rows) preserves per-writer prefixes,
+    so ``seq <= vv[w]`` is exactly "this log already holds that op".  Rows
+    with rid outside [0, n_writers) — e.g. a Go peer's rid = -1 ops
+    (crdt_tpu.api.node) — have no watermark and are never considered covered.
+    """
+    valid = (log.ts != SENTINEL) & (log.rid >= 0) & (log.rid < n_writers)
+    rid_safe = jnp.where(valid, log.rid, n_writers)
+    return (
+        jnp.full((n_writers + 1,), -1, jnp.int32)
+        .at[rid_safe]
+        .max(jnp.where(valid, log.seq, -1))
+    )[:n_writers]
+
+
+def covered_by(log: OpLog, vv: jax.Array) -> jax.Array:
+    """bool[L]: which rows a peer holding version vector ``vv`` already has."""
+    n_writers = vv.shape[-1]
+    valid = log.ts != SENTINEL
+    in_range = (log.rid >= 0) & (log.rid < n_writers)
+    rid_safe = jnp.clip(log.rid, 0, n_writers - 1)
+    return valid & in_range & (log.seq <= vv[rid_safe])
+
+
+@jax.jit
+def delta_since(log: OpLog, vv: jax.Array) -> OpLog:
+    """Delta extraction: the sub-log of ops NOT covered by version vector
+    ``vv``, canonically re-sorted and padded (same capacity).
+
+    This is the delta-gossip primitive — the reference ships its entire op
+    log every round (/root/reference/main.go:159, unbounded payload growth,
+    SURVEY.md §6); here a sender keeps only what the receiver is missing.
+    The same operation drops already-folded rows after a compaction-frontier
+    advance (crdt_tpu.models.compactlog).
+    """
+    cov = covered_by(log, vv)
+
+    def key_col(c):
+        return jnp.where(cov, SENTINEL, c)
+
+    def val_col(c):
+        return jnp.where(cov, jnp.zeros_like(c), c)
+
+    out = jax.lax.sort(
+        [
+            key_col(log.ts), key_col(log.rid), key_col(log.seq),
+            key_col(log.key),
+            val_col(log.val), val_col(log.payload), val_col(log.is_num),
+        ],
+        num_keys=4,
+        is_stable=True,
+    )
+    return OpLog(ts=out[0], rid=out[1], seq=out[2], key=out[3],
+                 val=out[4], payload=out[5], is_num=out[6])
+
+
 def append_batch(log: OpLog, ops: Mapping[str, jax.Array], batch_capacity: int | None = None) -> OpLog:
     """Local write path (the reference's AddCommand log append, main.go:187):
     merge a freshly-packed op batch into the log."""

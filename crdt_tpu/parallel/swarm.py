@@ -120,6 +120,60 @@ def converge(s: Swarm, join_batched: Callable, neutral: Any) -> Swarm:
     return s.replace(state=broadcast_where_alive(s.state, s.alive, top))
 
 
+def stable_frontier(
+    received: jax.Array, alive: jax.Array, frontiers: jax.Array | None = None
+) -> jax.Array:
+    """The swarm's stable frontier: elementwise min over the *alive*
+    replicas' received version vectors (``received``: int32[R, W]).
+
+    Every op at or under this frontier is held by every alive replica, so all
+    of them can fold it away deterministically (crdt_tpu.models.compactlog).
+    Dead replicas' KNOWLEDGE is excluded — safe, because any op they uniquely
+    hold is one they authored but never gossiped out, whose seq is above
+    every alive replica's watermark for that writer and hence above the min.
+
+    ``frontiers`` (int32[R, W], every replica's CURRENT folded watermark,
+    dead included) enforces the chain rule: the new barrier must dominate
+    every existing fold — a dead replica's summary may be the only copy of
+    what it folded, and a non-dominating barrier would mint an incomparable
+    frontier generation (silent data loss at its revival merge).  When the
+    alive set cannot dominate, the result is all -1: fold nothing this
+    round; barriers resume after the revived replica's fold spreads.
+    With no alive replicas the frontier is likewise -1.
+    """
+    masked = jnp.where(alive[:, None], received, jnp.int32(2**31 - 1))
+    f = masked.min(axis=0)
+    ok = jnp.any(alive)
+    if frontiers is not None:
+        ok &= jnp.all(f >= jnp.max(frontiers, axis=0))
+    return jnp.where(ok, f, jnp.int32(-1))
+
+
+def compaction_round(
+    s: Swarm, received_vv: Callable, compact: Callable, frontier_of: Callable
+) -> Swarm:
+    """One swarm-wide compaction barrier: agree on the stable frontier and
+    have every alive replica fold exactly that op set.
+
+    `received_vv` maps one replica state -> int32[W]; `compact` maps
+    (one replica state, frontier) -> state; `frontier_of` maps one replica
+    state -> its current int32[W] folded watermark (chain-rule input, see
+    stable_frontier).  Dead replicas keep their state (and their old
+    frontier — they rejoin the chain via one merge on revival).  This is the
+    jitted equivalent of a coordinated log-pruning pass, which the reference
+    never does (its log grows forever, /root/reference/main.go:75,
+    SURVEY.md §6).
+    """
+    received = jax.vmap(received_vv)(s.state)
+    frontiers = jax.vmap(frontier_of)(s.state)
+    frontier = stable_frontier(received, s.alive, frontiers)
+    folded = jax.vmap(lambda st: compact(st, frontier))(s.state)
+    state = jax.tree.map(
+        lambda f, x: jnp.where(_alive_mask(s.alive, f), f, x), folded, s.state
+    )
+    return s.replace(state=state)
+
+
 def n_diverged(s: Swarm, join_batched: Callable, neutral: Any) -> jax.Array:
     """Convergence-lag metric: how many alive replicas are NOT yet at the
     swarm-wide least upper bound (0 = converged)."""

@@ -46,6 +46,8 @@ def save_node(path: str, node) -> None:
             {"ts": k[0], "rid": k[1], "seq": k[2], "cmd": v}
             for k, v in node._commands.items()
         ],
+        "frontier": [[r, s] for r, s in node._frontier.items()],
+        "summary": node._summary,
     }
     (p / "meta.json").write_text(json.dumps(meta))
 
@@ -73,6 +75,9 @@ def restore_node(path: str, node) -> None:
     node._commands = {
         (c["ts"], c["rid"], c["seq"]): c["cmd"] for c in meta["commands"]
     }
+    node._frontier = {int(r): int(s) for r, s in meta.get("frontier", [])}
+    node._summary = meta.get("summary", {})
+    node._rebuild_indexes_locked()  # delta indexes + summary-cache invalidation
 
 
 def save_swarm(path: str, state: Any) -> None:

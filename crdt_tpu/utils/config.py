@@ -35,6 +35,15 @@ class ClusterConfig:
     # friend_range - n_replicas dead ports (quirk §0.1.9); False gives the
     # fixed uniform-live-peer topology
     reference_topology: bool = False
+    # delta gossip: pullers send their version vector and receive only ops
+    # they are missing (the reference re-ships its ENTIRE log every round,
+    # main.go:159 — payload grows without bound, SURVEY.md §6)
+    delta_gossip: bool = True
+    # fold swarm-stable ops into per-key summaries every N ticks (0 = never —
+    # the reference's behavior, main.go:75: the log only ever grows).  NOT
+    # wire-compatible with a Go reference peer (see crdt_tpu.api.node
+    # FRONTIER_KEY); leave at 0 for mixed deployments.
+    compact_every: int = 0
 
     def ports(self) -> List[int]:
         return [self.base_port + i for i in range(self.n_replicas)]

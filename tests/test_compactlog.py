@@ -1,0 +1,333 @@
+"""Delta gossip + stable-frontier compaction tests.
+
+The reference never prunes its op log and re-ships the whole log every round
+(/root/reference/main.go:75, main.go:159 — SURVEY.md §6 "unbounded growth");
+crdt_tpu.models.compactlog bounds both.  These tests check the two contracts
+that make that sound:
+
+* delta extraction is lossless: merging a vv-filtered delta equals merging
+  the full log;
+* compaction is observably transparent: rebuild() is invariant under any
+  sanctioned frontier advance, across merges, gossip, and fault injection.
+
+Version vectors assume per-writer contiguous seqs (crdt_tpu.utils.clock
+.SeqGen), so the generators here build writer histories as prefixes —
+helpers.rand_ops's free-form (rid, seq) pairs would violate the invariant.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crdt_tpu.models import compactlog, oplog
+from crdt_tpu.parallel import swarm
+from tests.helpers import tree_equal
+
+W = 3   # writers
+K = 8   # interned key space
+CAP = 64
+
+
+def writer_histories(rng, n_writers=W, max_per_writer=8, n_keys=K):
+    """Per-writer op columns: seq contiguous from 0, ts strictly increasing
+    with seq (as a real node's clock+SeqGen produce)."""
+    cols = {n: [] for n in ("ts", "rid", "seq", "key", "val", "payload", "is_num")}
+    for w in range(n_writers):
+        n_w = int(rng.integers(1, max_per_writer + 1))
+        for s in range(n_w):
+            cols["ts"].append(10 * s + w)  # unique + per-writer monotone
+            cols["rid"].append(w)
+            cols["seq"].append(s)
+            cols["key"].append(int(rng.integers(0, n_keys)))
+            is_num = bool(rng.random() < 0.7)
+            cols["val"].append(int(rng.integers(-20, 21)) if is_num else 0)
+            cols["payload"].append(int(rng.integers(0, 100)))
+            cols["is_num"].append(is_num)
+    return {
+        n: np.asarray(c, bool if n == "is_num" else np.int32)
+        for n, c in cols.items()
+    }
+
+
+def prefix_log(ops, prefix_per_writer, capacity=CAP):
+    """A replica's log: the given per-writer prefix of each history."""
+    keep = ops["seq"] < np.asarray(prefix_per_writer)[ops["rid"]]
+    return oplog.from_ops(capacity, {k: v[keep] for k, v in ops.items()})
+
+
+def rand_prefixes(rng, ops, n_writers=W):
+    return [
+        int(rng.integers(0, int((ops["rid"] == w).sum()) + 1))
+        for w in range(n_writers)
+    ]
+
+
+# ---- version vectors + delta extraction ----
+
+
+def test_version_vector_matches_numpy():
+    rng = np.random.default_rng(0)
+    ops = writer_histories(rng)
+    pre = rand_prefixes(rng, ops)
+    log = prefix_log(ops, pre)
+    vv = np.asarray(oplog.version_vector(log, W))
+    assert vv.tolist() == [p - 1 for p in pre]
+
+
+def test_foreign_rid_rows_never_covered():
+    # Go-peer ops arrive with rid = -1 (crdt_tpu.api.node) — no watermark.
+    ops = {
+        "ts": np.asarray([5], np.int32),
+        "rid": np.asarray([-1], np.int32),
+        "seq": np.asarray([0], np.int32),
+        "key": np.asarray([2], np.int32),
+        "val": np.asarray([7], np.int32),
+        "payload": np.asarray([0], np.int32),
+        "is_num": np.asarray([True], bool),
+    }
+    log = oplog.from_ops(8, ops)
+    assert np.asarray(oplog.version_vector(log, W)).tolist() == [-1] * W
+    vv = jnp.full((W,), 100, jnp.int32)
+    assert not bool(oplog.covered_by(log, vv)[0])
+    assert int(oplog.size(oplog.delta_since(log, vv))) == 1
+
+
+def test_delta_since_is_lossless():
+    """merge(a, delta_since(b, vv(a))) == merge(a, b) — the delta-gossip
+    payload carries exactly what the receiver is missing."""
+    rng = np.random.default_rng(1)
+    for trial in range(10):
+        ops = writer_histories(rng)
+        a = prefix_log(ops, rand_prefixes(rng, ops))
+        b = prefix_log(ops, rand_prefixes(rng, ops))
+        vv_a = oplog.version_vector(a, W)
+        delta = oplog.delta_since(b, vv_a)
+        assert tree_equal(oplog.merge(a, delta), oplog.merge(a, b))
+        # and the delta is disjoint from a's knowledge
+        assert int(jnp.sum(oplog.covered_by(delta, vv_a))) == 0
+
+
+# ---- compaction transparency ----
+
+
+def _rand_stable_frontier(rng, *logs):
+    """A frontier every given log can fold (≤ the min received vv) —
+    what swarm.stable_frontier produces for this replica set."""
+    vvs = np.stack([np.asarray(oplog.version_vector(l, W)) for l in logs])
+    lo = vvs.min(axis=0)
+    return jnp.asarray(
+        [int(rng.integers(-1, lo[w] + 1)) if lo[w] >= 0 else -1 for w in range(W)],
+        jnp.int32,
+    )
+
+
+def test_rebuild_invariant_under_compaction():
+    rng = np.random.default_rng(2)
+    for trial in range(10):
+        ops = writer_histories(rng)
+        log = prefix_log(ops, rand_prefixes(rng, ops))
+        want = oplog.rebuild(log, K)
+        c = compactlog.fresh(log, K, W)
+        f1 = _rand_stable_frontier(rng, log)
+        c1 = compactlog.compact(c, f1)
+        assert tree_equal(compactlog.rebuild(c1), want)
+        # a second, further advance over the already-compacted state
+        c2 = compactlog.compact(c1, oplog.version_vector(log, W))
+        assert tree_equal(compactlog.rebuild(c2), want)
+        # fully folded: the tail is empty, state lives in the summary
+        assert int(compactlog.size(c2)) == 0
+
+
+def test_compact_clamps_to_received():
+    """A frontier beyond this replica's knowledge must not advance past it
+    (it would make merges drop never-received ops as already-folded)."""
+    rng = np.random.default_rng(3)
+    ops = writer_histories(rng)
+    log = prefix_log(ops, rand_prefixes(rng, ops))
+    c = compactlog.compact(
+        compactlog.fresh(log, K, W), jnp.full((W,), 10_000, jnp.int32)
+    )
+    assert np.array_equal(
+        np.asarray(c.frontier), np.asarray(oplog.version_vector(log, W))
+    )
+    assert tree_equal(compactlog.rebuild(c), oplog.rebuild(log, K))
+
+
+def test_merge_equals_raw_union_across_frontier_chain():
+    """merge over (behind, ahead) frontier pairs — dead-replica revival —
+    equals the raw oplog union, observably."""
+    rng = np.random.default_rng(4)
+    for trial in range(10):
+        ops = writer_histories(rng)
+        a_log = prefix_log(ops, rand_prefixes(rng, ops))
+        b_log = prefix_log(ops, rand_prefixes(rng, ops))
+        want = oplog.rebuild(oplog.merge(a_log, b_log), K)
+
+        # chain: f0 ≤ f1; a (revived) folded only f0, b reached f1
+        f0 = _rand_stable_frontier(rng, a_log, b_log)
+        f1 = _rand_stable_frontier(rng, b_log)
+        f1 = jnp.maximum(f0, f1)
+        a = compactlog.compact(compactlog.fresh(a_log, K, W), f0)
+        b = compactlog.compact(
+            compactlog.compact(compactlog.fresh(b_log, K, W), f0), f1
+        )
+        for m in (compactlog.merge(a, b), compactlog.merge(b, a)):
+            assert tree_equal(compactlog.rebuild(m), want)
+            assert np.array_equal(
+                np.asarray(m.frontier), np.asarray(jnp.maximum(a.frontier, b.frontier))
+            )
+
+
+def test_merge_laws_same_frontier():
+    """Within one frontier generation, merge is a lattice join: commutative,
+    associative, idempotent (structurally — canonical sorted tails)."""
+    rng = np.random.default_rng(5)
+    ops = writer_histories(rng)
+    logs = [prefix_log(ops, rand_prefixes(rng, ops)) for _ in range(3)]
+    f = _rand_stable_frontier(rng, *logs)
+    a, b, c = (
+        compactlog.compact(compactlog.fresh(l, K, W), f) for l in logs
+    )
+    assert tree_equal(compactlog.merge(a, b), compactlog.merge(b, a))
+    assert tree_equal(
+        compactlog.merge(compactlog.merge(a, b), c),
+        compactlog.merge(a, compactlog.merge(b, c)),
+    )
+    assert tree_equal(compactlog.merge(a, a), a)
+
+
+# ---- swarm integration: gossip + compaction barriers + faults ----
+
+
+def _compact_swarm(logs):
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[compactlog.fresh(l, K, W) for l in logs],
+    )
+    return swarm.make(stacked)
+
+
+def test_swarm_compaction_round_bounds_tails():
+    rng = np.random.default_rng(6)
+    ops = writer_histories(rng, max_per_writer=10)
+    logs = [prefix_log(ops, rand_prefixes(rng, ops)) for _ in range(6)]
+    want_each = [oplog.rebuild(l, K) for l in logs]
+    s = _compact_swarm(logs)
+
+    s2 = swarm.compaction_round(
+        s, compactlog.received_vv, compactlog.compact, lambda c: c.frontier
+    )
+    # every replica folded the same frontier; nothing observable changed
+    fr = np.asarray(s2.state.frontier)
+    assert (fr == fr[0]).all()
+    for i, want in enumerate(want_each):
+        got = compactlog.rebuild(jax.tree.map(lambda x: x[i], s2.state))
+        assert tree_equal(got, want)
+    # tails shrank by exactly the folded stable prefix
+    before = np.asarray(jax.vmap(compactlog.size)(s.state))
+    after = np.asarray(jax.vmap(compactlog.size)(s2.state))
+    assert (after <= before).all()
+    vvs = np.stack([np.asarray(oplog.version_vector(l, W)) for l in logs])
+    assert (after == before - np.sum(vvs.min(axis=0) + 1)).all()
+
+
+def test_swarm_gossip_then_compact_then_converge():
+    """Full lifecycle: gossip rounds, a compaction barrier mid-flight, more
+    gossip — every replica converges to the union's view with empty tails
+    after a final barrier."""
+    rng = np.random.default_rng(7)
+    ops = writer_histories(rng, max_per_writer=10)
+    logs = [prefix_log(ops, rand_prefixes(rng, ops)) for _ in range(6)]
+    union = logs[0]
+    for l in logs[1:]:
+        union = oplog.merge(union, l)
+    want = oplog.rebuild(union, K)
+
+    s = _compact_swarm(logs)
+    join_b = jax.vmap(compactlog.merge)
+    key = jax.random.key(7)
+    for i in range(12):
+        key, k = jax.random.split(key)
+        peers = swarm.random_peers(k, swarm.n_replicas(s))
+        s = swarm.gossip_round(s, peers, join_b)
+        if i == 3:
+            s = swarm.compaction_round(
+                s, compactlog.received_vv, compactlog.compact,
+                lambda c: c.frontier,
+            )
+    neutral = compactlog.empty(CAP, K, W)
+    s = swarm.converge(s, join_b, neutral)
+    s = swarm.compaction_round(s, compactlog.received_vv, compactlog.compact, lambda c: c.frontier)
+    for i in range(len(logs)):
+        got = compactlog.rebuild(jax.tree.map(lambda x: x[i], s.state))
+        assert tree_equal(got, want)
+    # everything stable got folded: tails are empty
+    assert (np.asarray(jax.vmap(compactlog.size)(s.state)) == 0).all()
+
+
+def test_dead_replica_misses_barrier_then_catches_up():
+    rng = np.random.default_rng(8)
+    ops = writer_histories(rng, max_per_writer=10)
+    logs = [prefix_log(ops, rand_prefixes(rng, ops)) for _ in range(4)]
+    union = logs[0]
+    for l in logs[1:]:
+        union = oplog.merge(union, l)
+    want = oplog.rebuild(union, K)
+
+    s = _compact_swarm(logs)
+    join_b = jax.vmap(compactlog.merge)
+    neutral = compactlog.empty(CAP, K, W)
+    dead = 2
+    s = swarm.set_alive(s, dead, False)
+    s = swarm.converge(s, join_b, neutral)               # alive-only fixpoint
+    s = swarm.compaction_round(s, compactlog.received_vv, compactlog.compact, lambda c: c.frontier)
+    # dead replica kept its state and its -1 frontier (behind on the chain)
+    assert int(s.state.frontier[dead].max()) == -1
+
+    s = swarm.set_alive(s, dead, True)
+    s = swarm.converge(s, join_b, neutral)               # revival catch-up
+    for i in range(len(logs)):
+        got = compactlog.rebuild(jax.tree.map(lambda x: x[i], s.state))
+        assert tree_equal(got, want)
+
+
+def test_barrier_skipped_when_frontier_holders_dead():
+    """Chain rule: a barrier held while the only holders of the previous
+    frontier are dead must NOT advance (the alive set lacks ops that exist
+    only inside the dead replicas' summaries); it resumes after revival."""
+    rng = np.random.default_rng(9)
+    ops = writer_histories(rng, max_per_writer=6)
+    full = [int((ops["rid"] == w).sum()) for w in range(W)]
+    # replicas 0,1 know writers 0,1 fully; replica 2 knows only writer 2
+    know_01 = prefix_log(ops, [full[0], full[1], 0])
+    know_2 = prefix_log(ops, [0, 0, full[2]])
+    union = oplog.merge(know_01, know_2)
+    want = oplog.rebuild(union, K)
+
+    s = _compact_swarm([know_01, know_01, know_2])
+    join_b = jax.vmap(compactlog.merge)
+    neutral = compactlog.empty(CAP, K, W)
+    args = (compactlog.received_vv, compactlog.compact, lambda c: c.frontier)
+
+    # barrier 1: replica 2 dead -> 0,1 fold writers 0,1
+    s = swarm.set_alive(s, 2, False)
+    s = swarm.compaction_round(s, *args)
+    f1 = np.asarray(s.state.frontier)
+    assert (f1[0] == [full[0] - 1, full[1] - 1, -1]).all()
+
+    # now 0,1 die and 2 revives: barrier must SKIP (frontiers unchanged)
+    s = swarm.set_alive(s, 0, False)
+    s = swarm.set_alive(s, 1, False)
+    s = swarm.set_alive(s, 2, True)
+    s2 = swarm.compaction_round(s, *args)
+    assert np.array_equal(np.asarray(s2.state.frontier), f1)
+
+    # full revival: converge spreads the fold, then the barrier resumes
+    for r in range(3):
+        s2 = swarm.set_alive(s2, r, True)
+    s2 = swarm.converge(s2, join_b, neutral)
+    s2 = swarm.compaction_round(s2, *args)
+    fr = np.asarray(s2.state.frontier)
+    assert (fr == [f - 1 for f in full]).all()
+    for i in range(3):
+        got = compactlog.rebuild(jax.tree.map(lambda x: x[i], s2.state))
+        assert tree_equal(got, want)

@@ -1,0 +1,207 @@
+"""Host-runtime delta gossip + compaction tests (crdt_tpu.api).
+
+The device-side contracts live in tests/test_compactlog.py; these check the
+wire/runtime layer: version-vector payload filtering, summary adoption on
+revival, command-map pruning, checkpoint round-trips, and that a compacting
+cluster stays observably identical to a reference-faithful (never-pruning)
+one — the capability the reference lacks (its log and gossip payload grow
+without bound, /root/reference/main.go:75, main.go:159).
+"""
+import numpy as np
+import pytest
+
+from crdt_tpu.api.cluster import LocalCluster
+from crdt_tpu.api.node import FRONTIER_KEY, SUMMARY_KEY, ReplicaNode
+from crdt_tpu.models import oplog
+from crdt_tpu.utils.clock import HostClock
+from crdt_tpu.utils.config import ClusterConfig
+
+
+def _mk_cluster(**kw):
+    kw.setdefault("n_replicas", 4)
+    kw.setdefault("log_capacity", 64)
+    return LocalCluster(ClusterConfig(**kw))
+
+
+def _drive(cluster, writes, seed=0):
+    rng = np.random.default_rng(seed)
+    for i, (key, val) in enumerate(writes):
+        rid = int(rng.integers(0, len(cluster.nodes)))
+        cluster.nodes[rid].add_command({key: val}, ts=i * 10)
+    return cluster
+
+
+WRITES = [
+    ("a", "5"), ("b", "-20"), ("a", "7"), ("c", "hello"),
+    ("b", "3"), ("c", "world"), ("a", "-1"), ("d", "007"),
+]
+
+
+def _converge(cluster, max_ticks=60):
+    for _ in range(max_ticks):
+        cluster.tick()
+        if cluster.converged():
+            return True
+    return cluster.converged()
+
+
+def test_delta_payload_excludes_known_ops():
+    c = _mk_cluster()
+    _drive(c, WRITES)
+    a, b = c.nodes[0], c.nodes[1]
+    full = b.gossip_payload()
+    delta = b.gossip_payload(since=b.version_vector())
+    assert delta == {}  # b needs nothing from itself
+    # a pull with a's vv carries exactly b's ops that a is missing
+    d = b.gossip_payload(since=a.version_vector())
+    assert set(d) <= set(full)
+    a_known = set(a._commands)
+    for k in full:
+        ts, rid, seq = map(int, k.split(":"))
+        missing = (ts - a.clock.epoch_ms, rid, seq) not in a_known
+        assert (k in d) == missing
+
+
+def test_delta_and_full_gossip_converge_identically():
+    ca = _drive(_mk_cluster(delta_gossip=True), WRITES)
+    cb = _drive(_mk_cluster(delta_gossip=False), WRITES)
+    assert _converge(ca) and _converge(cb)
+    assert ca.nodes[0].get_state() == cb.nodes[0].get_state()
+
+
+def test_compaction_preserves_state_and_prunes():
+    c = _drive(_mk_cluster(), WRITES)
+    assert _converge(c)
+    want = [n.get_state() for n in c.nodes]
+    sizes_before = [len(n._commands) for n in c.nodes]
+    frontier = c.compact()
+    assert frontier  # everything was stable post-convergence
+    for n, w, sz in zip(c.nodes, want, sizes_before):
+        assert n.get_state() == w
+        assert len(n._commands) < sz
+        assert len(n._commands) == 0  # fully stable -> fully folded
+        assert int(oplog.size(n.log)) == 0
+        assert n._summary
+
+
+def test_compacting_cluster_matches_reference_faithful_one():
+    """End-to-end: periodic barriers + delta gossip + continued writes give
+    the same observable states as the never-pruning configuration."""
+    ca = _mk_cluster(compact_every=3)
+    cb = _mk_cluster(compact_every=0, delta_gossip=False)
+    for cl in (ca, cb):
+        _drive(cl, WRITES)
+        for _ in range(4):
+            cl.tick()
+        _drive(cl, [("e", "100"), ("a", "2"), ("f", "xyz")], seed=1)
+        assert _converge(cl)
+    assert ca.nodes[0].get_state() == cb.nodes[0].get_state()
+    # and compaction actually bounded the command maps
+    ca.compact()
+    assert all(len(n._commands) == 0 for n in ca.nodes)
+    assert all(len(n._commands) > 0 for n in cb.nodes)
+
+
+def test_gossip_after_compaction_ships_summary_not_ops():
+    c = _drive(_mk_cluster(), WRITES)
+    assert _converge(c)
+    c.compact()
+    fresh = ReplicaNode(rid=99, capacity=64, clock=HostClock())
+    payload = c.nodes[0].gossip_payload(since=fresh.version_vector())
+    assert FRONTIER_KEY in payload and SUMMARY_KEY in payload
+    fresh.receive(payload)
+    assert fresh.get_state() == c.nodes[0].get_state()
+    # a requester that already covers the frontier gets neither section
+    p2 = c.nodes[0].gossip_payload(since=c.nodes[1].version_vector())
+    assert FRONTIER_KEY not in p2 and SUMMARY_KEY not in p2
+
+
+def test_dead_node_misses_barrier_then_adopts_summary():
+    c = _drive(_mk_cluster(), WRITES)
+    assert _converge(c)
+    dead = c.nodes[2]
+    dead.set_alive(False)
+    # new writes + a barrier while node 2 is down
+    c.nodes[0].add_command({"z": "41"}, ts=10_000)
+    assert _converge(c)
+    c.compact()
+    assert dead._frontier == {}  # missed the barrier
+    dead.set_alive(True)
+    assert _converge(c)
+    assert dead.get_state() == c.nodes[0].get_state()
+    assert dead._frontier == c.nodes[0]._frontier
+
+
+def test_refolded_ops_are_not_reingested():
+    """A full (legacy, since=None) payload re-delivering folded ops must not
+    double-count them against the summary."""
+    c = _drive(_mk_cluster(), WRITES)
+    assert _converge(c)
+    want = c.nodes[0].get_state()
+    legacy = c.nodes[1].gossip_payload()  # full dump, pre-compaction
+    c.compact()
+    c.nodes[0].receive(legacy)
+    assert c.nodes[0].get_state() == want
+
+
+def test_incomparable_frontiers_fail_loudly():
+    c = _drive(_mk_cluster(), WRITES)
+    assert _converge(c)
+    c.compact()
+    n = c.nodes[0]
+    bad_frontier = {str(r): s for r, s in n._frontier.items()}
+    some = next(iter(n._frontier))
+    bad_frontier[str(some)] = n._frontier[some] - 1
+    bad_frontier["97"] = 5  # ahead on a writer we never folded
+    with pytest.raises(ValueError, match="incomparable"):
+        n.receive({FRONTIER_KEY: bad_frontier, SUMMARY_KEY: {}})
+
+
+def test_checkpoint_roundtrips_compaction_state():
+    from crdt_tpu.utils import checkpoint
+
+    c = _drive(_mk_cluster(), WRITES)
+    assert _converge(c)
+    c.compact()
+    node = c.nodes[1]
+    want = node.get_state()
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        checkpoint.save_node(d, node)
+        clone = ReplicaNode(rid=node.rid, capacity=64)
+        checkpoint.restore_node(d, clone)
+        assert clone._frontier == node._frontier
+        assert clone._summary == node._summary
+        assert clone.get_state() == want
+
+
+def test_barrier_skipped_when_frontier_holders_dead():
+    """Host chain rule (the wedge scenario): node2 dead through barrier 1;
+    then nodes 0,1 die and node 2 (with fresh writes) is the only one up —
+    compact() must skip rather than mint an incomparable frontier, and the
+    cluster must fully recover after revival."""
+    c = _mk_cluster(n_replicas=3)
+    c.nodes[2].set_alive(False)
+    c.nodes[0].add_command({"a": "5"}, ts=10)
+    c.nodes[1].add_command({"b": "7"}, ts=20)
+    assert _converge(c)
+    f1 = c.compact()
+    assert f1  # barrier 1 succeeded (among nodes 0,1)
+
+    c.nodes[0].set_alive(False)
+    c.nodes[1].set_alive(False)
+    c.nodes[2].set_alive(True)
+    c.nodes[2].add_command({"z": "1"}, ts=30)
+    assert c.compact() == {}  # skipped: nodes 0,1 hold the only fold copies
+    assert c.nodes[2]._frontier == {}
+
+    for n in c.nodes:
+        n.set_alive(True)
+    assert _converge(c)  # revival merges stay on the chain -> no ValueError
+    states = [n.get_state() for n in c.nodes]
+    assert states[0] == states[1] == states[2]
+    assert states[0]["a"] == "5" and states[0]["z"] == "1"
+    f2 = c.compact()  # barrier resumes once the fold has spread
+    assert all(f2.get(r, -1) >= s for r, s in f1.items())
+    assert all(n.get_state() == states[0] for n in c.nodes)
