@@ -1,0 +1,355 @@
+"""Full BASELINE suite: every target config from BASELINE.md, one JSON line
+each (same schema as bench.py), optionally rendered into BENCH_TABLE.md.
+
+Configs (BASELINE.md "Target configs"):
+  gcounter_pair      2-replica increment+merge (the reference's default path,
+                     /root/reference/main.go:35-100) — single-merge latency.
+  pncounter_vmap_1k  1K replicas, batched vector join (vmap elementwise max).
+  lww_argmax_100k    100K registers, (ts, rid) lexicographic argmax join.
+  orset_union        columnar Pallas sorted-segment union (BASELINE shape is
+                     1M x 1K; default here is HBM-safe and the rate scales
+                     linearly in lanes — override with --lanes).
+  gossip_allreduce   10K-replica swarm: full convergence (tree-reduced join
+                     fixpoint) per step — one step == the gossip fixpoint the
+                     reference needs many 1500 ms rounds to reach.
+
+Timing uses the same RTT-cancellation as bench.py: K work-steps chained
+inside ONE jitted fori_loop, per-step time = difference quotient between two
+K values (the ~75 ms tunnel round-trip cancels).  Every loop body consumes a
+bank of distinct peer states via dynamic indexing so XLA cannot algebraically
+collapse the idempotent joins (see bench.py header).
+
+Usage:
+  python benches/bench_baseline.py                 # full suite on the chip
+  python benches/bench_baseline.py --write-md      # also refresh BENCH_TABLE.md
+  python benches/bench_baseline.py --tiny --cpu    # CI smoke (tests/)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+REPS = 5
+
+
+MIN_DIFF_S = 0.02  # the diff must clear the ~75 ms tunnel-RTT jitter floor
+
+
+def _timed(fn, k_small, k_large, reps=REPS, min_diff=MIN_DIFF_S):
+    """Best-of-reps difference quotient: seconds per work-step.
+
+    Adaptive: if t(k_large) - t(k_small) is inside the dispatch-jitter floor
+    (small configs finish thousands of loop steps in less than the tunnel
+    RTT noise), quadruple both K values and retry, so the measured delta is
+    always dominated by on-device work."""
+
+    def run(k):
+        fn(k)  # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(k)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    for _ in range(6):
+        t1, t2 = run(k_small), run(k_large)
+        if t2 - t1 >= min_diff:
+            break
+        k_small, k_large = k_small * 4, k_large * 4
+    else:
+        if min_diff > 0:
+            print(
+                f"# WARNING: diff {t2 - t1:.2e}s never cleared the "
+                f"{min_diff}s noise floor (K up to {k_large}); "
+                "rate below is an upper bound, not a measurement",
+                file=sys.stderr,
+            )
+    return max((t2 - t1) / (k_large - k_small), 1e-12)
+
+
+def _emit(results, name, value, unit, note):
+    line = {"metric": name, "value": round(value, 1), "unit": unit,
+            "vs_baseline": None, "note": note}
+    print(json.dumps(line), flush=True)
+    results.append(line)
+
+
+# ---- configs ----------------------------------------------------------------
+
+
+def bench_gcounter_pair(results, tiny):
+    """2-replica merge latency: one pairwise G-Counter join (8 writer slots),
+    the reference's whole merge() hot path (main.go:35-100) as one fused op."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.models import gcounter
+
+    bank_n, nodes = 16, 8
+    ks = jax.random.split(jax.random.key(1), 2)
+    a = gcounter.GCounter(
+        jax.random.randint(ks[0], (nodes,), 0, 1 << 20, dtype=jnp.int32))
+    bank = jax.random.randint(ks[1], (bank_n, nodes), 0, 1 << 20,
+                              dtype=jnp.int32)
+
+    @partial(jax.jit, static_argnames="k")
+    def chained(c, bank, k):
+        def body(i, x):
+            peer = jax.lax.dynamic_index_in_dim(bank, i % bank_n,
+                                                keepdims=False)
+            return jnp.maximum(x, peer)
+
+        return jax.lax.fori_loop(0, k, body, c.counts).sum()
+
+    ks_, kl = (8, 32) if tiny else (256, 2048)
+    per = _timed(lambda k: int(chained(a, bank, k)), ks_, kl,
+                 min_diff=0 if tiny else MIN_DIFF_S)
+    _emit(results, "gcounter_pair_merge_latency", per * 1e9, "ns/merge",
+          "2-replica increment+merge, 8 writer slots (reference default path)")
+
+
+def bench_pncounter_vmap(results, tiny):
+    """1K replicas, batched PN-Counter join: both planes, one fused max."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.models import pncounter
+
+    r = 64 if tiny else 1024
+    bank_n, nodes = 8, 64
+    ks = jax.random.split(jax.random.key(2), 3)
+    c = pncounter.PNCounter(
+        pos=jax.random.randint(ks[0], (r, nodes), 0, 1 << 20, dtype=jnp.int32),
+        neg=jax.random.randint(ks[1], (r, nodes), 0, 1 << 20, dtype=jnp.int32),
+    )
+    bank = jax.random.randint(ks[2], (bank_n, 2, r, nodes), 0, 1 << 20,
+                              dtype=jnp.int32)
+
+    @partial(jax.jit, static_argnames="k")
+    def chained(c, bank, k):
+        def body(i, x):
+            pos, neg = x
+            peer = jax.lax.dynamic_index_in_dim(bank, i % bank_n,
+                                                keepdims=False)
+            return (jnp.maximum(pos, peer[0]), jnp.maximum(neg, peer[1]))
+
+        pos, neg = jax.lax.fori_loop(0, k, body, (c.pos, c.neg))
+        return pos.sum() - neg.sum()
+
+    ks_, kl = (8, 32) if tiny else (256, 2048)
+    per = _timed(lambda k: int(chained(c, bank, k)), ks_, kl,
+                 min_diff=0 if tiny else MIN_DIFF_S)
+    _emit(results, "pncounter_vmap_replica_merges_per_sec", r / per,
+          "replica-merges/s", f"{r}-replica batched PN join, {nodes} slots")
+
+
+def bench_lww_argmax(results, tiny):
+    """100K registers: lexicographic (ts, rid) argmax select join."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.models import lww
+
+    r = 1 << 10 if tiny else 100_352  # 98 * 1024 (lane-aligned ~100K)
+    bank_n = 8
+    ks = jax.random.split(jax.random.key(3), 4)
+
+    def rand_reg(kt, kr, kp, shape):
+        return lww.LWWRegister(
+            ts=jax.random.randint(kt, shape, 0, 1 << 20, dtype=jnp.int32),
+            rid=jax.random.randint(kr, shape, 0, 64, dtype=jnp.int32),
+            payload=jax.random.randint(kp, shape, 0, 1 << 20, dtype=jnp.int32),
+        )
+
+    a = rand_reg(ks[0], ks[1], ks[2], (r,))
+    bks = jax.random.split(ks[3], 3)
+    bank = rand_reg(bks[0], bks[1], bks[2], (bank_n, r))
+
+    @partial(jax.jit, static_argnames="k")
+    def chained(a, bank, k):
+        def body(i, x):
+            peer = jax.tree.map(
+                lambda l: jax.lax.dynamic_index_in_dim(l, i % bank_n,
+                                                       keepdims=False), bank)
+            return lww.join(x, peer)
+
+        out = jax.lax.fori_loop(0, k, body, a)
+        return out.ts.sum() + out.payload.sum()
+
+    ks_, kl = (8, 32) if tiny else (128, 1024)
+    per = _timed(lambda k: int(chained(a, bank, k)), ks_, kl,
+                 min_diff=0 if tiny else MIN_DIFF_S)
+    _emit(results, "lww_argmax_replica_merges_per_sec", r / per,
+          "replica-merges/s", f"{r}-register (ts, rid) argmax join")
+
+
+def bench_orset_union(results, tiny, lanes=None, capacity=None):
+    """Columnar Pallas sorted-segment union (BASELINE hard config)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import pallas_union
+    from crdt_tpu.utils.constants import SENTINEL
+
+    c = capacity or (64 if tiny else 1024)
+    ln = lanes or (128 if tiny else 1 << 17)  # 128K lanes is HBM-safe
+    bank_n = 2
+    interpret = jax.default_backend() != "tpu"
+
+    def cols(key, fill):
+        ks = jax.random.randint(key, (c, ln), 0, 1 << 30, dtype=jnp.int32)
+        ks = jax.lax.sort(ks, dimension=0)
+        keys = jnp.where(jnp.arange(c)[:, None] < fill, ks, SENTINEL)
+        return keys, (ks & 1).astype(jnp.int32)
+
+    kk = jax.random.split(jax.random.key(4), bank_n + 1)
+    ka, va = cols(kk[0], c // 2)
+    bank = [cols(k2, c // 2) for k2 in kk[1:]]
+    bank_k = jnp.stack([b[0] for b in bank])
+    bank_v = jnp.stack([b[1] for b in bank])
+
+    @partial(jax.jit, static_argnames="k")
+    def chained(ka, va, bank_k, bank_v, k):
+        def body(i, carry):
+            kx, vx = carry
+            j = i % bank_n
+            kb = jax.lax.dynamic_index_in_dim(bank_k, j, keepdims=False)
+            vb = jax.lax.dynamic_index_in_dim(bank_v, j, keepdims=False)
+            ko, vo, _ = pallas_union.sorted_union_columnar(
+                kx, vx, kb, vb, out_size=c, interpret=interpret)
+            return ko, vo
+
+        ko, vo = jax.lax.fori_loop(0, k, body, (ka, va))
+        return ko.sum() + vo.sum()
+
+    if interpret:
+        # interpret-pallas inside fori_loop is pathologically slow: one eager
+        # union proves the path; skip the rate measurement off-TPU
+        out = pallas_union.sorted_union_columnar(
+            ka, va, bank_k[0], bank_v[0], out_size=c, interpret=True)
+        jax.block_until_ready(out)
+        _emit(results, "orset_pallas_union_smoke", 1, "ok",
+              f"interpret-mode union C={c} lanes={ln} (no TPU)")
+        return
+    ks_, kl = (2, 6) if tiny else (8, 32)
+    per = _timed(lambda k: int(chained(ka, va, bank_k, bank_v, k)), ks_, kl,
+                 min_diff=0 if tiny else MIN_DIFF_S)
+    _emit(results, "orset_pallas_replica_unions_per_sec", ln / per,
+          "replica-unions/s",
+          f"bitonic-merge union, C={c} tags x {ln} replicas "
+          f"(rate is lane-linear; BASELINE shape 1M x 1K)")
+
+
+def bench_gossip_allreduce(results, tiny):
+    """10K-replica swarm convergence: one step = tree-reduced join fixpoint +
+    broadcast (what the reference needs many 1500 ms gossip rounds for)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import joins
+    from crdt_tpu.models import gcounter
+
+    r = 256 if tiny else 10_240
+    bank_n, nodes = 4, 8
+    ks = jax.random.split(jax.random.key(5), 2)
+    state = jax.random.randint(ks[0], (r, nodes), 0, 1 << 20, dtype=jnp.int32)
+    bank = jax.random.randint(ks[1], (bank_n, r, nodes), 0, 1 << 20,
+                              dtype=jnp.int32)
+    neutral = gcounter.zero(nodes)
+
+    @partial(jax.jit, static_argnames="k")
+    def chained(state, bank, k):
+        def body(i, x):
+            peer = jax.lax.dynamic_index_in_dim(bank, i % bank_n,
+                                                keepdims=False)
+            x = jnp.maximum(x, peer)  # fresh writes land on every replica
+            top = joins.tree_reduce_join(
+                lambda a, b: gcounter.GCounter(jnp.maximum(a.counts, b.counts)),
+                gcounter.GCounter(x), neutral)
+            return jnp.broadcast_to(top.counts[None], x.shape)
+
+        return jax.lax.fori_loop(0, k, body, state).sum()
+
+    ks_, kl = (4, 16) if tiny else (64, 512)
+    per = _timed(lambda k: int(chained(state, bank, k)), ks_, kl,
+                 min_diff=0 if tiny else MIN_DIFF_S)
+    _emit(results, "gossip_allreduce_converges_per_sec", 1.0 / per,
+          "converges/s",
+          f"{r}-replica full convergence per step "
+          f"({r / per:.3g} replica-merges/s equivalent)")
+
+
+# ---- driver -----------------------------------------------------------------
+
+ALL = {
+    "gcounter_pair": bench_gcounter_pair,
+    "pncounter_vmap": bench_pncounter_vmap,
+    "lww_argmax": bench_lww_argmax,
+    "orset_union": bench_orset_union,
+    "gossip_allreduce": bench_gossip_allreduce,
+}
+
+
+def write_md(results, path):
+    backend = None
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        pass
+    lines = [
+        "# BENCH_TABLE — full BASELINE suite results",
+        "",
+        f"Backend: `{backend}` · produced by `benches/bench_baseline.py` "
+        "(difference-quotient timing; see module docstring).",
+        "Headline metric (driver-run) lives in `bench.py`; reference "
+        "publishes no numbers (BASELINE.md).",
+        "",
+        "| metric | value | unit | notes |",
+        "|---|---:|---|---|",
+    ]
+    for r in results:
+        v = r["value"]
+        pretty = f"{v:,.1f}" if v < 1e6 else f"{v:.3e}"
+        lines.append(f"| {r['metric']} | {pretty} | {r['unit']} | {r['note']} |")
+    lines.append("")
+    path.write_text("\n".join(lines))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true", help="CI smoke shapes")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--only", choices=sorted(ALL), default=None)
+    ap.add_argument("--lanes", type=int, default=None,
+                    help="orset_union replica count override")
+    ap.add_argument("--capacity", type=int, default=None)
+    ap.add_argument("--write-md", action="store_true",
+                    help="refresh BENCH_TABLE.md at the repo root")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    results = []
+    for name, fn in ALL.items():
+        if args.only and name != args.only:
+            continue
+        if name == "orset_union":
+            fn(results, args.tiny, lanes=args.lanes, capacity=args.capacity)
+        else:
+            fn(results, args.tiny)
+    if args.write_md:
+        write_md(results, REPO / "BENCH_TABLE.md")
+
+
+if __name__ == "__main__":
+    main()

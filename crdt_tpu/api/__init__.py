@@ -1,2 +1,3 @@
 from crdt_tpu.api.node import ReplicaNode  # noqa: F401
 from crdt_tpu.api.cluster import LocalCluster  # noqa: F401
+from crdt_tpu.api.net import NetworkAgent, NodeHost, RemotePeer  # noqa: F401

@@ -16,7 +16,7 @@ import random
 import threading
 from typing import Dict, List, Optional
 
-from crdt_tpu.api.node import FRONTIER_KEY, SUMMARY_KEY, ReplicaNode
+from crdt_tpu.api.node import ReplicaNode, pull_round
 from crdt_tpu.utils.clock import HostClock
 from crdt_tpu.utils.config import ClusterConfig
 from crdt_tpu.utils.metrics import Metrics
@@ -29,7 +29,7 @@ class LocalCluster:
         clock = HostClock()
         self.nodes: List[ReplicaNode] = [
             ReplicaNode(
-                rid=i,
+                rid=self.config.rid_base + i,
                 capacity=self.config.log_capacity,
                 clock=clock,
                 metrics=self.metrics,
@@ -56,45 +56,33 @@ class LocalCluster:
             return self.nodes[idx]
         return None  # a never-started friend port (quirk §0.1.9)
 
-    def _friend_pool(self, rid: int) -> List[Optional[ReplicaNode]]:
+    def _friend_pool(self, idx: int) -> List[Optional[ReplicaNode]]:
         if self.config.reference_topology:
             # self + all friend ports, live or not (main.go:220-222)
             return [self.node_by_port(p) for p in self.config.friend_ports()]
-        return [n for n in self.nodes if n.rid != rid]
+        return [n for n in self.nodes if n is not self.nodes[idx]]
 
     # ---- deterministic gossip rounds ----
 
-    def gossip_once(self, rid: int) -> bool:
-        """One pull by replica `rid` from a random friend; returns True if a
-        merge happened (dead/missing peers are skipped, main.go:235-239)."""
-        node = self.nodes[rid]
-        peer = self._rng.choice(self._friend_pool(rid))
-        if peer is None or peer is node or not peer.alive or not node.alive:
+    def gossip_once(self, idx: int) -> bool:
+        """One pull by the idx-th replica from a random friend; returns True
+        if a merge happened (dead/missing peers are skipped, main.go:235-239)."""
+        node = self.nodes[idx]
+        peer = self._rng.choice(self._friend_pool(idx))
+        if peer is None or peer is node or not peer.alive:
             self.metrics.inc("gossip_skipped")
             return False
-        since = node.version_vector() if self.config.delta_gossip else None
-        payload = peer.gossip_payload(since=since)
-        if payload is None:
-            self.metrics.inc("gossip_skipped")
-            return False
-        if not payload:  # delta mode: peer had nothing we lack — no merge
-            self.metrics.inc("gossip_noop")
-            return False
-        self.metrics.inc(
-            "gossip_payload_ops",
-            sum(1 for k in payload if k not in (FRONTIER_KEY, SUMMARY_KEY)),
+        return pull_round(
+            node,
+            lambda since: peer.gossip_payload(since=since),
+            self.metrics,
+            delta=self.config.delta_gossip,
         )
-        fresh = node.receive(payload)
-        if not fresh:  # payload was all re-deliveries (e.g. foreign ops)
-            self.metrics.inc("gossip_noop")
-            return False
-        self.metrics.inc("gossip_rounds")
-        return True
 
     def tick(self) -> int:
         """One gossip round for every replica; returns merges performed.
         Every config.compact_every-th tick also runs a compaction barrier."""
-        merges = sum(self.gossip_once(rid) for rid in range(len(self.nodes)))
+        merges = sum(self.gossip_once(idx) for idx in range(len(self.nodes)))
         self._ticks += 1
         every = self.config.compact_every
         if every and self._ticks % every == 0:
@@ -148,8 +136,8 @@ class LocalCluster:
 
     def start(self) -> None:
         self._stop.clear()
-        for rid in range(len(self.nodes)):
-            t = threading.Thread(target=self._loop, args=(rid,), daemon=True)
+        for idx in range(len(self.nodes)):
+            t = threading.Thread(target=self._loop, args=(idx,), daemon=True)
             t.start()
             self._threads.append(t)
 
@@ -163,20 +151,20 @@ class LocalCluster:
                 f"{len(self.errors)} background gossip loop(s) died"
             ) from self.errors[0]
 
-    def _loop(self, rid: int) -> None:
-        """Background pull loop for one replica.  Replica 0's loop doubles as
-        the compaction scheduler so config.compact_every works in live mode
-        too (one designated scheduler: barriers must not race each other;
-        racing a barrier against concurrent gossip is safe — the per-node
-        clamp makes the common target frontier valid regardless)."""
+    def _loop(self, idx: int) -> None:
+        """Background pull loop for one replica.  The 0th replica's loop
+        doubles as the compaction scheduler so config.compact_every works in
+        live mode too (one designated scheduler: barriers must not race each
+        other; racing a barrier against concurrent gossip is safe — the
+        per-node clamp makes the common target frontier valid regardless)."""
         period = self.config.gossip_period_ms / 1000.0
         rounds = 0
         while not self._stop.wait(period):
             try:
-                self.gossip_once(rid)
+                self.gossip_once(idx)
                 rounds += 1
                 every = self.config.compact_every
-                if rid == 0 and every and rounds % every == 0:
+                if idx == 0 and every and rounds % every == 0:
                     self.compact()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.metrics.inc("gossip_loop_errors")

@@ -1,0 +1,213 @@
+"""Cross-process networking: the host-level distributed runtime.
+
+The reference "distributes" by running every replica in one OS process and
+gossiping over loopback HTTP (/root/reference/main.go:226-267, 316-323).
+This module is the real thing: replicas in different processes (or hosts)
+gossiping over the same five-endpoint wire surface.  Three pieces:
+
+* ``RemotePeer``  — HTTP client for the reference surface (works against a
+  crdt_tpu ``HttpCluster``/``NodeHost`` *or* the original Go server: the
+  wire format is the reference's JSON op-log dump, main.go:159).
+* ``NetworkAgent``— the anti-entropy pull loop of one local ReplicaNode over
+  a list of peer URLs (the goroutine at main.go:226-261, with delta gossip
+  and loud failure handling instead of quirk §0.1.8's silent death).
+* ``NodeHost``    — one replica + its HTTP endpoint + its agent: the
+  standalone deployment unit (the reference's `createServer`,
+  main.go:217-271, as an actual network daemon).
+
+Gossip payloads carry raw strings and absolute-ms wire keys (see
+crdt_tpu.api.node), so peers never share an interner or an epoch — the same
+code path spans process and host boundaries.  Writer-id ranges must be
+disjoint across processes (ClusterConfig.rid_base).
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from crdt_tpu.api.node import ReplicaNode, pull_round
+from crdt_tpu.utils.config import ClusterConfig
+from crdt_tpu.utils.metrics import Metrics
+
+
+class RemotePeer:
+    """Client for one peer's reference-surface HTTP endpoint."""
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str) -> Optional[bytes]:
+        try:
+            with urllib.request.urlopen(
+                self.url + path, timeout=self.timeout
+            ) as res:
+                return res.read() if res.status == 200 else None
+        except (urllib.error.URLError, OSError):
+            return None  # unreachable/dead peer: caller skips (main.go:235-239)
+
+    def ping(self) -> bool:
+        """GET /ping (main.go:115-127)."""
+        return self._get("/ping") is not None
+
+    def get_state(self) -> Optional[Dict[str, str]]:
+        """GET /data (main.go:129-139); None when down/unreachable."""
+        body = self._get("/data")
+        return None if body is None else json.loads(body)
+
+    def gossip_payload(
+        self, since: Optional[Dict[int, int]] = None
+    ) -> Optional[Dict[str, Any]]:
+        """GET /gossip (main.go:154-171); ``since`` = our version vector for
+        delta gossip (?vv=...), None requests the full-log dump."""
+        path = "/gossip"
+        if since is not None:
+            vv = json.dumps({str(r): s for r, s in since.items()})
+            path += "?vv=" + urllib.parse.quote(vv)
+        body = self._get(path)
+        return None if body is None else json.loads(body)
+
+    def add_command(self, cmd: Dict[str, str]) -> bool:
+        """POST /data (main.go:173-215)."""
+        req = urllib.request.Request(
+            self.url + "/data",
+            data=json.dumps(cmd).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as res:
+                return res.status == 200
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def set_alive(self, alive: bool) -> bool:
+        """GET /condition/<bool> (main.go:141-152, routing fixed §0.1.7)."""
+        return self._get(f"/condition/{str(bool(alive)).lower()}") is not None
+
+
+class NetworkAgent:
+    """Anti-entropy pull loop for one local node over peer URLs.
+
+    ``gossip_once`` = one pull round (random peer, delta payload, merge);
+    ``start``/``stop`` run it every ``gossip_period_ms`` in a daemon thread.
+    Failures of individual pulls are skipped (the reference's 502 path);
+    failures of the *loop* are recorded and re-raised by ``stop()`` — the
+    reference's loop dies silently forever on one bad payload (§0.1.8).
+    """
+
+    def __init__(
+        self,
+        node: ReplicaNode,
+        peer_urls: List[str],
+        config: Optional[ClusterConfig] = None,
+        metrics: Optional[Metrics] = None,
+        seed: Optional[int] = None,
+    ):
+        self.node = node
+        self.peers = [RemotePeer(u) for u in peer_urls]
+        self.config = config or ClusterConfig()
+        self.metrics = metrics or node.metrics
+        self._rng = random.Random(self.config.seed if seed is None else seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.errors: List[Exception] = []
+
+    def gossip_once(self) -> bool:
+        if not self.peers:
+            self.metrics.inc("net_gossip_skipped")
+            return False
+        peer = self._rng.choice(self.peers)
+        return pull_round(
+            self.node,
+            peer.gossip_payload,
+            self.metrics,
+            delta=self.config.delta_gossip,
+            prefix="net_gossip",
+        )
+
+    def start(self) -> None:
+        self._stop.clear()
+        self.errors.clear()  # a restart begins a fresh failure record
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self.errors:
+            raise RuntimeError("network gossip loop died") from self.errors[0]
+
+    def _loop(self) -> None:
+        period = self.config.gossip_period_ms / 1000.0
+        while not self._stop.wait(period):
+            try:
+                self.gossip_once()
+            except Exception as e:  # noqa: BLE001 — surfaced via stop()
+                self.metrics.inc("net_gossip_loop_errors")
+                self.errors.append(e)
+                raise
+
+
+class NodeHost:
+    """One replica, served and gossiping: the multi-process deployment unit.
+
+    Boot one per process (or several per process — they only share code):
+
+        host = NodeHost(rid=3, peers=["http://other:8080"], port=8083)
+        host.start()
+        ...
+        host.stop()
+
+    The HTTP surface is the reference's five endpoints (crdt_tpu.api
+    .http_shim); the agent pulls a random peer every gossip_period_ms.
+    """
+
+    def __init__(
+        self,
+        rid: int,
+        peers: List[str],
+        port: int = 0,
+        host: str = "127.0.0.1",
+        config: Optional[ClusterConfig] = None,
+        capacity: Optional[int] = None,
+    ):
+        from crdt_tpu.api.http_shim import _make_handler
+
+        self.config = config or ClusterConfig()
+        self.node = ReplicaNode(
+            rid=rid, capacity=capacity or self.config.log_capacity
+        )
+        self.nodes = [self.node]  # duck-types as a cluster for the handler
+        self.agent = NetworkAgent(self.node, peers, self.config)
+        self._server = ThreadingHTTPServer(
+            (host, port), _make_handler(self, 0)
+        )
+        self.port: int = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._server_thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._server_thread.start()
+        self.agent.start()
+
+    def stop(self) -> None:
+        try:
+            self.agent.stop()
+        finally:
+            self._server.shutdown()
+            self._server.server_close()
+            if self._server_thread is not None:
+                self._server_thread.join(timeout=5)
+                self._server_thread = None

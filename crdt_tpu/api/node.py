@@ -72,6 +72,39 @@ def _parse_wire_key(k: str) -> Tuple[int, int, int]:
     return int(k), -1, 0  # Go-format key: millisecond timestamp only
 
 
+def pull_round(node: "ReplicaNode", fetch_payload, metrics, delta: bool,
+               prefix: str = "gossip") -> bool:
+    """One anti-entropy pull into ``node`` — the shared round body of every
+    gossip driver (in-process LocalCluster, cross-process NetworkAgent): ask
+    the peer for a (delta) payload, merge it, and keep the skip/noop/fresh
+    counters consistent across transports.
+
+    ``fetch_payload(since)`` returns the peer's payload dict, or None for an
+    unreachable/dead peer (the reference's 502-skip, main.go:235-239).
+    """
+    if not node.alive:
+        metrics.inc(f"{prefix}_skipped")
+        return False
+    since = node.version_vector() if delta else None
+    payload = fetch_payload(since)
+    if payload is None:
+        metrics.inc(f"{prefix}_skipped")
+        return False
+    if not payload:  # delta mode: peer had nothing we lack — no merge
+        metrics.inc(f"{prefix}_noop")
+        return False
+    metrics.inc(
+        f"{prefix}_payload_ops",
+        sum(1 for k in payload if k not in (FRONTIER_KEY, SUMMARY_KEY)),
+    )
+    fresh = node.receive(payload)
+    if not fresh:  # payload was all re-deliveries (e.g. foreign ops)
+        metrics.inc(f"{prefix}_noop")
+        return False
+    metrics.inc(f"{prefix}_rounds")
+    return True
+
+
 class ReplicaNode:
     def __init__(
         self,

@@ -35,17 +35,23 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from crdt_tpu.utils.constants import SENTINEL
 
 LANES = 128
 
 
-def _merge_kernel(ka_ref, va_ref, kb_ref, vb_ref, ko_ref, vo_ref):
-    """Merge two per-lane sorted (C, LANES) tiles into sorted (2C, LANES)."""
+def _merge_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref):
+    """Merge a per-lane sorted (C, LANES) tile with an already-REVERSED
+    (descending) one into sorted (2C, LANES).
+
+    The B side arrives pre-reversed because Mosaic has no lowering for the
+    `rev` primitive (jnp.flip) inside a TPU kernel; the wrapper flips B in
+    XLA where it fuses with the operand copy (one cheap HBM-bound pass)."""
     c = ka_ref.shape[0]
-    keys = jnp.concatenate([ka_ref[:], jnp.flip(kb_ref[:], axis=0)], axis=0)
-    vals = jnp.concatenate([va_ref[:], jnp.flip(vb_ref[:], axis=0)], axis=0)
+    keys = jnp.concatenate([ka_ref[:], kbr_ref[:]], axis=0)
+    vals = jnp.concatenate([va_ref[:], vbr_ref[:]], axis=0)
 
     stride = c
     while stride >= 1:
@@ -97,7 +103,13 @@ def bitonic_merge_columnar(
             jax.ShapeDtypeStruct((2 * c, lanes), vals_a.dtype),
         ],
         interpret=interpret,
-    )(keys_a, vals_a, keys_b, vals_b)
+        # the compare-exchange stages keep ~a dozen (2C, 128) temporaries
+        # live; the default 16M scoped-vmem budget trips at C=1024 (v5e has
+        # 128M physical VMEM), so grant the kernel what the worst stage needs
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=96 * 1024 * 1024,
+        ),
+    )(keys_a, vals_a, jnp.flip(keys_b, axis=0), jnp.flip(vals_b, axis=0))
     return ko, vo
 
 

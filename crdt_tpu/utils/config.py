@@ -31,6 +31,11 @@ class ClusterConfig:
     delta_max: int = -11
     log_capacity: int = 1024        # per-replica op-tensor capacity (grows 2x)
     seed: int = 0
+    # first writer id of this cluster: multi-process/multi-host deployments
+    # give each process a disjoint [rid_base, rid_base + n_replicas) range so
+    # version vectors and op identities stay globally unique (the reference
+    # identifies writers only implicitly, by port — main.go:319)
+    rid_base: int = 0
     # reference-faithful gossip topology: friend list includes self and
     # friend_range - n_replicas dead ports (quirk §0.1.9); False gives the
     # fixed uniform-live-peer topology
