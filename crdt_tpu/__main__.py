@@ -1,0 +1,185 @@
+"""Runnable demo + deployment entry point: ``python -m crdt_tpu``.
+
+Default mode reproduces the reference's ``go run main.go`` experience
+(/root/reference/main.go:316-327): N replicas on consecutive ports with the
+five-endpoint HTTP surface, background anti-entropy gossip, and the random
+workload generator POSTing to random replicas — plus what the reference
+never had: a periodic automated convergence report (the reference was
+checked by a human polling GET /data and eyeballing equality, SURVEY.md §4).
+
+Daemon mode (``--daemon``) runs ONE replica as a real network process —
+point several at each other (on one machine or many) for an actual
+multi-process/multi-host deployment:
+
+    python -m crdt_tpu --daemon --rid 0 --port 8080 --peers http://h2:8080
+    python -m crdt_tpu --daemon --rid 1 --port 8080 --peers http://h1:8080
+
+Both modes speak the reference wire format, so a fleet can mix these with
+the original Go server (mixed fleets: leave --compact-every at 0; see
+crdt_tpu.api.node).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def run_demo(args) -> int:
+    from crdt_tpu.api.cluster import LocalCluster
+    from crdt_tpu.api.http_shim import HttpCluster
+    from crdt_tpu.harness.workload import WorkloadGenerator
+    from crdt_tpu.utils.config import ClusterConfig
+
+    cfg = ClusterConfig(
+        n_replicas=args.replicas,
+        base_port=args.base_port,
+        gossip_period_ms=args.gossip_ms,
+        write_period_ms=args.write_ms,
+        reference_topology=args.reference_topology,
+        compact_every=args.compact_every,
+        delta_gossip=not args.full_gossip,
+    )
+    cluster = LocalCluster(cfg)
+    http = HttpCluster(cluster)
+    ports = http.start(
+        None if args.ephemeral_ports else cfg.ports()
+    )
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    print(f"serving {len(urls)} replicas: {', '.join(urls)}")
+
+    cluster.start()  # background gossip loops (reference-live mode)
+    wg = WorkloadGenerator(cfg, seed=args.seed)
+    t_end = time.time() + args.duration if args.duration else None
+    writes = 0
+    last_report = time.time()
+    try:
+        while t_end is None or time.time() < t_end:
+            writes += wg.drive_http(urls, 1)
+            if time.time() - last_report >= args.report_every:
+                converged = cluster.converged()
+                alive = [s for s in cluster.states() if s is not None]
+                keys = len(alive[0]) if alive else 0
+                m = cluster.metrics.snapshot()
+                print(
+                    f"[{time.strftime('%H:%M:%S')}] writes={writes} "
+                    f"keys={keys} converged={converged} "
+                    f"gossip_rounds={m.get('gossip_rounds', 0)} "
+                    f"payload_ops={m.get('gossip_payload_ops', 0)} "
+                    f"merge_p50_ms={m.get('merge_p50_ms', 'n/a')}"
+                )
+                last_report = time.time()
+            time.sleep(cfg.write_period_ms / 1000.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cluster.stop()
+        http.stop()
+
+    # final report: drive to the fixpoint (bounded: random-peer pulls can
+    # miss — especially under --reference-topology's dead-port friend list)
+    ok = cluster.converged()
+    for _ in range(64 * len(cluster.nodes)):
+        if ok:
+            break
+        cluster.tick()
+        ok = cluster.converged()
+    alive = [s for s in cluster.states() if s is not None]
+    print(f"final: writes={writes} converged={ok} "
+          f"state_keys={len(alive[0]) if alive else 0}")
+    if args.dump_state and alive:
+        print(json.dumps(alive[0], sort_keys=True))
+    return 0 if ok else 1
+
+
+def run_daemon(args) -> int:
+    from crdt_tpu.api.net import NodeHost
+    from crdt_tpu.utils.config import ClusterConfig
+
+    if args.compact_every:
+        # a compaction barrier needs a swarm-stable frontier agreed across
+        # every replica; the cross-daemon barrier protocol is not built yet,
+        # so refuse rather than silently grow the log forever
+        print("--compact-every is not supported in --daemon mode "
+              "(needs a cross-process barrier; use the demo/cluster mode)",
+              file=sys.stderr)
+        return 2
+    cfg = ClusterConfig(
+        gossip_period_ms=args.gossip_ms,
+        delta_gossip=not args.full_gossip,
+    )
+    peers = [u for u in (args.peers or "").split(",") if u]
+    host = NodeHost(
+        rid=args.rid, peers=peers, port=args.port, config=cfg
+    )
+    host.start()
+    print(f"replica rid={args.rid} serving on {host.url}, "
+          f"{len(peers)} peer(s)")
+    t_end = time.time() + args.duration if args.duration else None
+    try:
+        while t_end is None or time.time() < t_end:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        host.stop()
+    state = host.node.get_state()
+    print(f"final: state_keys={len(state) if state else 0}")
+    if args.dump_state and state:
+        print(json.dumps(state, sort_keys=True))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m crdt_tpu",
+        description="TPU-native CRDT store: demo swarm or single daemon.",
+    )
+    ap.add_argument("--replicas", type=int, default=5,
+                    help="demo: replica count (reference: 5, main.go:319)")
+    ap.add_argument("--base-port", type=int, default=8080)
+    ap.add_argument("--ephemeral-ports", action="store_true",
+                    help="demo: let the OS pick ports (CI-safe)")
+    ap.add_argument("--gossip-ms", type=int, default=1500,
+                    help="anti-entropy period (reference: 1500, main.go:229)")
+    ap.add_argument("--write-ms", type=int, default=300,
+                    help="demo workload period (reference: 300, main.go:280)")
+    ap.add_argument("--duration", type=float, default=0,
+                    help="seconds to run (0 = until Ctrl-C)")
+    ap.add_argument("--report-every", type=float, default=5.0)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--reference-topology", action="store_true",
+                    help="demo: friend list includes self + dead ports "
+                         "(reference quirk §0.1.9)")
+    ap.add_argument("--compact-every", type=int, default=0,
+                    help="fold swarm-stable ops every N rounds (0 = never, "
+                         "the reference's unbounded-log behavior)")
+    ap.add_argument("--full-gossip", action="store_true",
+                    help="ship the full log every round (reference behavior) "
+                         "instead of deltas")
+    ap.add_argument("--dump-state", action="store_true")
+    ap.add_argument("--daemon", action="store_true",
+                    help="run ONE network replica instead of the demo swarm")
+    ap.add_argument("--rid", type=int, default=0,
+                    help="daemon: globally unique writer id")
+    ap.add_argument("--port", type=int, default=8080,
+                    help="daemon: listen port (0 = ephemeral)")
+    ap.add_argument("--peers", type=str, default="",
+                    help="daemon: comma-separated peer base URLs")
+    ap.add_argument("--platform", choices=["cpu", "tpu", "ambient"],
+                    default="cpu",
+                    help="JAX backend for the host runtime (default cpu: "
+                         "a handful of replicas' merges are host-latency "
+                         "bound; the chip pays off at swarm scale — see "
+                         "bench.py/benches/)")
+    args = ap.parse_args(argv)
+    if args.platform != "ambient":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    return run_daemon(args) if args.daemon else run_demo(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,1 +1,11 @@
-from crdt_tpu.models import gcounter, pncounter, lww, orset, oplog, compactlog  # noqa: F401
+from crdt_tpu.models import (  # noqa: F401
+    compactlog,
+    flags,
+    gcounter,
+    gset,
+    lww,
+    mvregister,
+    oplog,
+    orset,
+    pncounter,
+)

@@ -1,0 +1,135 @@
+"""EW-Flag / DW-Flag: observed-token boolean lattices, array-encoded for TPU.
+
+The reference's only boolean is the ``Alive`` health flag, mutated in place
+with races (/root/reference/main.go:31, §0.1.5/§0.1.7) — not replicated.  A
+complete CRDT framework ships replicated flags with a deterministic answer
+to concurrent enable/disable; these are the standard observed-remove
+constructions (enable-wins and disable-wins), built on one shared plane:
+
+``TokenPlane`` (writer universe ``W``):
+* ``tok: int32[..., W]``    — per-writer seq of that writer's latest token
+                              (-1 = none);
+* ``obs: int32[..., W, W]`` — ``obs[w, j]`` = token seq of writer ``j``
+                              observed at writer ``w``'s latest *clear*.
+
+``active`` = some token is unobserved by every clear — i.e. a token that no
+clear saw survives (the observed-remove rule).  Token = bump own ``tok``
+slot; clear = copy the currently-held ``tok`` vector into own ``obs`` row.
+join = elementwise max of both fields — a pure max-lattice, so flags ride
+the ``pmax`` collective fast path (crdt_tpu.parallel.mesh.pmax_converge)
+unchanged.
+
+* **EWFlag** — tokens are enables, disables clear: concurrent
+  enable||disable reads True.
+* **DWFlag** — tokens are disables, enables clear (plus a monotone
+  ``touched`` bit so the initial state reads False): concurrent
+  enable||disable reads False.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TokenPlane:
+    tok: jax.Array  # int32[..., W]
+    obs: jax.Array  # int32[..., W, W]
+
+    @property
+    def n_writers(self) -> int:
+        return self.tok.shape[-1]
+
+
+def plane_zero(n_writers: int, batch: tuple = ()) -> TokenPlane:
+    return TokenPlane(
+        tok=jnp.full((*batch, n_writers), -1, jnp.int32),
+        obs=jnp.full((*batch, n_writers, n_writers), -1, jnp.int32),
+    )
+
+
+def plane_token(p: TokenPlane, writer) -> TokenPlane:
+    return p.replace(tok=p.tok.at[..., writer].add(1))
+
+
+def plane_clear(p: TokenPlane, writer) -> TokenPlane:
+    return p.replace(obs=p.obs.at[..., writer, :].set(p.tok))
+
+
+def plane_join(a: TokenPlane, b: TokenPlane) -> TokenPlane:
+    return TokenPlane(
+        tok=jnp.maximum(a.tok, b.tok), obs=jnp.maximum(a.obs, b.obs)
+    )
+
+
+def plane_active(p: TokenPlane) -> jax.Array:
+    """bool[...]: does an unobserved (never-cleared) token exist?"""
+    seen = p.obs.max(axis=-2)  # best clear per token writer
+    return ((p.tok >= 0) & (p.tok > seen)).any(axis=-1)
+
+
+# ---- EW-Flag: enable-wins ---------------------------------------------------
+
+
+@struct.dataclass
+class EWFlag:
+    plane: TokenPlane  # tokens = enables
+
+
+def ew_zero(n_writers: int, batch: tuple = ()) -> EWFlag:
+    return EWFlag(plane=plane_zero(n_writers, batch))
+
+
+def ew_enable(f: EWFlag, writer) -> EWFlag:
+    return EWFlag(plane=plane_token(f.plane, writer))
+
+
+def ew_disable(f: EWFlag, writer) -> EWFlag:
+    """Disable clears only *observed* enables: a concurrent enable wins."""
+    return EWFlag(plane=plane_clear(f.plane, writer))
+
+
+def ew_join(a: EWFlag, b: EWFlag) -> EWFlag:
+    return EWFlag(plane=plane_join(a.plane, b.plane))
+
+
+def ew_value(f: EWFlag) -> jax.Array:
+    return plane_active(f.plane)
+
+
+# ---- DW-Flag: disable-wins --------------------------------------------------
+
+
+@struct.dataclass
+class DWFlag:
+    plane: TokenPlane   # tokens = disables
+    touched: jax.Array  # bool[...]: ever enabled (monotone OR)
+
+
+def dw_zero(n_writers: int, batch: tuple = ()) -> DWFlag:
+    return DWFlag(
+        plane=plane_zero(n_writers, batch), touched=jnp.zeros(batch, bool)
+    )
+
+
+def dw_enable(f: DWFlag, writer) -> DWFlag:
+    """Enable clears only *observed* disables: a concurrent disable wins."""
+    return DWFlag(
+        plane=plane_clear(f.plane, writer),
+        touched=jnp.ones_like(f.touched),
+    )
+
+
+def dw_disable(f: DWFlag, writer) -> DWFlag:
+    return f.replace(plane=plane_token(f.plane, writer))
+
+
+def dw_join(a: DWFlag, b: DWFlag) -> DWFlag:
+    return DWFlag(
+        plane=plane_join(a.plane, b.plane), touched=a.touched | b.touched
+    )
+
+
+def dw_value(f: DWFlag) -> jax.Array:
+    return f.touched & ~plane_active(f.plane)

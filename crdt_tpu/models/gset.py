@@ -1,0 +1,142 @@
+"""G-Set and 2P-Set: grow-only / two-phase set lattices, array-encoded.
+
+The reference's op log is itself a grow-only set keyed by timestamp
+(/root/reference/main.go:26, union at main.go:49-73); these are that
+capability as first-class standalone sets.  The 2P-Set is the simplest
+set with removal (remove-wins forever, no re-add) — the stepping stone to
+the OR-Set (crdt_tpu.models.orset), which allows re-adding.
+
+Encoding: sorted, SENTINEL-padded, fixed-capacity element arrays — the same
+conventions as every sorted lattice here (crdt_tpu.ops.sorted_union); the
+2P-Set adds a monotone tombstone plane (join = OR on duplicates).  Joins
+whose true union exceeds capacity drop the largest elements; use the
+``*_checked`` variants where that must be detected (same contract as
+orset.join_checked).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from crdt_tpu.ops import sorted_union as su
+from crdt_tpu.utils.constants import SENTINEL
+
+
+@struct.dataclass
+class GSet:
+    elem: jax.Array  # int32[C] sorted ascending, SENTINEL padding
+
+    @property
+    def capacity(self) -> int:
+        return self.elem.shape[-1]
+
+
+@struct.dataclass
+class TwoPSet:
+    elem: jax.Array     # int32[C] sorted ascending, SENTINEL padding
+    removed: jax.Array  # bool[C]  tombstone (monotone: no re-add, ever)
+
+    @property
+    def capacity(self) -> int:
+        return self.elem.shape[-1]
+
+
+def g_empty(capacity: int) -> GSet:
+    return GSet(elem=jnp.full((capacity,), SENTINEL, jnp.int32))
+
+
+def tp_empty(capacity: int) -> TwoPSet:
+    return TwoPSet(
+        elem=jnp.full((capacity,), SENTINEL, jnp.int32),
+        removed=jnp.zeros((capacity,), bool),
+    )
+
+
+def _insert(elem_col, vals, new_elem, new_vals, capacity):
+    """Insert one element (no-op on duplicates: combine keeps the existing
+    row's values OR-ed with the new row's)."""
+    kb = jnp.full((1,), SENTINEL, jnp.int32).at[0].set(
+        jnp.asarray(new_elem, jnp.int32)
+    )
+    keys, vals, _ = su.sorted_union(
+        (elem_col,), vals, (kb,), new_vals,
+        combine=lambda a, b: jax.tree.map(jnp.logical_or, a, b),
+        out_size=capacity,
+    )
+    return keys[0], vals
+
+
+@jax.jit
+def g_add(s: GSet, elem) -> GSet:
+    out, _ = _insert(s.elem, {}, elem, {}, s.capacity)
+    return GSet(elem=out)
+
+
+@jax.jit
+def g_join(a: GSet, b: GSet) -> GSet:
+    out, _ = g_join_checked(a, b)
+    return out
+
+
+@jax.jit
+def g_join_checked(a: GSet, b: GSet):
+    keys, _, n = su.sorted_union(
+        (a.elem,), {}, (b.elem,), {}, out_size=a.capacity
+    )
+    return GSet(elem=keys[0]), n
+
+
+def g_contains(s: GSet, elem) -> jax.Array:
+    return jnp.any(s.elem == jnp.asarray(elem, jnp.int32))
+
+
+def g_size(s: GSet) -> jax.Array:
+    return jnp.sum(s.elem != SENTINEL).astype(jnp.int32)
+
+
+@jax.jit
+def tp_add(s: TwoPSet, elem) -> TwoPSet:
+    """Add is a no-op for an element ever removed (two-phase rule)."""
+    out, vals = _insert(
+        s.elem, {"removed": s.removed}, elem,
+        {"removed": jnp.zeros((1,), bool)}, s.capacity,
+    )
+    return TwoPSet(elem=out, removed=vals["removed"])
+
+
+@jax.jit
+def tp_remove(s: TwoPSet, elem) -> TwoPSet:
+    """Tombstone every present copy; removing an absent element inserts its
+    tombstone (so a later add cannot resurrect it — remove-wins)."""
+    out, vals = _insert(
+        s.elem, {"removed": s.removed}, elem,
+        {"removed": jnp.ones((1,), bool)}, s.capacity,
+    )
+    return TwoPSet(elem=out, removed=vals["removed"])
+
+
+@jax.jit
+def tp_join(a: TwoPSet, b: TwoPSet) -> TwoPSet:
+    out, _ = tp_join_checked(a, b)
+    return out
+
+
+@jax.jit
+def tp_join_checked(a: TwoPSet, b: TwoPSet):
+    keys, vals, n = su.sorted_union(
+        (a.elem,), {"removed": a.removed},
+        (b.elem,), {"removed": b.removed},
+        combine=lambda x, y: jax.tree.map(jnp.logical_or, x, y),
+        out_size=a.capacity,
+    )
+    return TwoPSet(elem=keys[0], removed=vals["removed"]), n
+
+
+def tp_contains(s: TwoPSet, elem) -> jax.Array:
+    e = jnp.asarray(elem, jnp.int32)
+    return jnp.any((s.elem == e) & ~s.removed)
+
+
+def tp_size(s: TwoPSet) -> jax.Array:
+    return jnp.sum((s.elem != SENTINEL) & ~s.removed).astype(jnp.int32)

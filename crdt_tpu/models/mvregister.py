@@ -1,0 +1,117 @@
+"""MV-Register: multi-value register lattice, array-encoded for TPU.
+
+The reference resolves concurrent writes to one key by silently dropping one
+side (newest-timestamp / local-wins, /root/reference/main.go:54-65, 77-85);
+the LWW register (crdt_tpu.models.lww) reproduces that capability.  The
+MV-Register is the lossless alternative every general CRDT framework ships:
+concurrent writes are all SURFACED (like Dynamo/Riak siblings) and only a
+later write that causally observed them collapses the set.
+
+Encoding (TPU-first: fixed shapes, join = elementwise select/max)
+-----------------------------------------------------------------
+For a writer universe of size ``W``, one register is:
+
+* ``seq: int32[..., W]``      — per-writer seq of that writer's latest write
+                                (-1 = never wrote);
+* ``ts, payload: int32[..., W]`` — that write's wall timestamp + interned
+                                value id;
+* ``obs: int32[..., W, W]``   — ``obs[w, j]`` = the seq of writer ``j``'s
+                                write that writer ``w`` had observed when it
+                                made its latest write (its causal context).
+
+Each writer keeps only its own newest write, so the state is a product of
+per-writer cells, and the join is a per-writer newest-wins select — O(W^2)
+memory, zero data-dependent shapes, vmaps over batches of registers.
+
+A write by ``w`` is *visible* (a current sibling) iff no writer's latest
+write causally covers it: ``all_j obs[j, w] < seq[w]``.  Overwrites collapse
+siblings because the new write's obs row records everything it saw.
+
+On equal seqs the join tie-breaks by elementwise max of (ts, payload, obs);
+reachable replicas carry identical cells for equal (writer, seq), so this
+only matters for making the join a true lattice join on ALL states
+(commutativity/associativity/idempotence hold unconditionally —
+tests/test_lattice_laws.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class MVRegister:
+    seq: jax.Array      # int32[..., W]
+    ts: jax.Array       # int32[..., W]
+    payload: jax.Array  # int32[..., W]
+    obs: jax.Array      # int32[..., W, W]
+
+    @property
+    def n_writers(self) -> int:
+        return self.seq.shape[-1]
+
+
+def zero(n_writers: int, batch: tuple = ()) -> MVRegister:
+    neg = jnp.full((*batch, n_writers), -1, jnp.int32)
+    return MVRegister(
+        seq=neg,
+        ts=jnp.zeros((*batch, n_writers), jnp.int32),
+        payload=jnp.zeros((*batch, n_writers), jnp.int32),
+        obs=jnp.full((*batch, n_writers, n_writers), -1, jnp.int32),
+    )
+
+
+def write(reg: MVRegister, writer, ts, payload) -> MVRegister:
+    """Local op: writer overwrites the register, causally covering every
+    write currently in its state (they become non-visible); concurrent
+    writes it has not seen survive as siblings."""
+    observed = reg.seq  # the causal context: everything this replica holds
+    return MVRegister(
+        seq=reg.seq.at[..., writer].add(1),
+        ts=reg.ts.at[..., writer].set(jnp.asarray(ts, jnp.int32)),
+        payload=reg.payload.at[..., writer].set(
+            jnp.asarray(payload, jnp.int32)
+        ),
+        obs=reg.obs.at[..., writer, :].set(observed),
+    )
+
+
+def join(a: MVRegister, b: MVRegister) -> MVRegister:
+    """Per-writer newest-wins select (ties: elementwise max, see header)."""
+    b_newer = b.seq > a.seq
+    tie = b.seq == a.seq
+
+    return MVRegister(
+        seq=jnp.maximum(a.seq, b.seq),
+        ts=jnp.where(
+            b_newer, b.ts, jnp.where(tie, jnp.maximum(a.ts, b.ts), a.ts)
+        ),
+        payload=jnp.where(
+            b_newer, b.payload,
+            jnp.where(tie, jnp.maximum(a.payload, b.payload), a.payload),
+        ),
+        obs=jnp.where(
+            b_newer[..., None], b.obs,
+            jnp.where(tie[..., None], jnp.maximum(a.obs, b.obs), a.obs),
+        ),
+    )
+
+
+def visible(reg: MVRegister) -> jax.Array:
+    """bool[..., W]: which writers' latest writes are current siblings
+    (written, and causally covered by no other held write)."""
+    wrote = reg.seq >= 0
+    # covered[w] = any writer's obs row saw seq[w] or later; a writer's own
+    # row never covers its newest write (obs[w, w] was recorded pre-bump)
+    covered = (reg.obs >= reg.seq[..., None, :]).any(axis=-2)
+    return wrote & ~covered
+
+
+def values(reg: MVRegister) -> tuple[jax.Array, jax.Array]:
+    """(mask, payload): the sibling set — payloads of visible writers."""
+    return visible(reg), reg.payload
+
+
+def n_siblings(reg: MVRegister) -> jax.Array:
+    return visible(reg).sum(axis=-1).astype(jnp.int32)

@@ -1,0 +1,34 @@
+"""Smoke tests for the runnable entry point (python -m crdt_tpu): the
+reference's end-to-end deployment experience (main.go:316-327) must boot,
+serve, converge, and exit cleanly in both modes."""
+import subprocess
+import sys
+
+
+def _run(args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "crdt_tpu", *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def test_demo_mode_converges():
+    p = _run([
+        "--replicas", "3", "--ephemeral-ports", "--duration", "4",
+        "--gossip-ms", "40", "--write-ms", "25", "--report-every", "1",
+        "--seed", "3", "--dump-state",
+    ])
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "serving 3 replicas" in p.stdout
+    assert "converged=True" in p.stdout
+
+
+def test_daemon_mode_boots_and_exits():
+    p = _run([
+        "--daemon", "--rid", "7", "--port", "0", "--duration", "1",
+    ])
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "replica rid=7 serving on" in p.stdout
+    assert "final: state_keys=0" in p.stdout

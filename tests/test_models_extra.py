@@ -1,0 +1,280 @@
+"""Semantics + law tests for the extra model families (MV-Register, EW/DW
+flags, G-Set/2P-Set) — the lattices beyond the reference's counter store
+that round out the framework (the reference resolves every concurrency
+question by dropping one side, /root/reference/main.go:54-65; these keep
+the deterministic-but-lossless alternatives available).
+
+Law coverage for the core four lattices lives in test_lattice_laws.py; the
+same four laws are asserted here for each new family on random *reachable*
+states (built by random op sequences), and additionally on ARBITRARY random
+arrays for the families whose join is total on any state (flags: pure max;
+mvregister: lexicographic seq-then-max) — the sorted-array sets are
+meaningful only on reachable (sorted, deduplicated) states.
+"""
+import zlib
+
+import jax
+import numpy as np
+import pytest
+
+from crdt_tpu.models import flags, gset, mvregister as mv
+from tests.helpers import tree_equal
+
+N_TRIALS = 20
+W = 4
+
+
+# ---- random reachable states ------------------------------------------------
+
+
+def rand_mv(rng: np.random.Generator) -> mv.MVRegister:
+    reg = mv.zero(W)
+    for _ in range(rng.integers(0, 6)):
+        reg = mv.write(
+            reg, int(rng.integers(0, W)), int(rng.integers(0, 100)),
+            int(rng.integers(0, 1000)),
+        )
+    return reg
+
+
+def rand_ew(rng: np.random.Generator) -> flags.EWFlag:
+    f = flags.ew_zero(W)
+    for _ in range(rng.integers(0, 6)):
+        w = int(rng.integers(0, W))
+        f = flags.ew_enable(f, w) if rng.random() < 0.5 else flags.ew_disable(f, w)
+    return f
+
+
+def rand_dw(rng: np.random.Generator) -> flags.DWFlag:
+    f = flags.dw_zero(W)
+    for _ in range(rng.integers(0, 6)):
+        w = int(rng.integers(0, W))
+        f = flags.dw_enable(f, w) if rng.random() < 0.5 else flags.dw_disable(f, w)
+    return f
+
+
+def rand_gset(rng: np.random.Generator) -> gset.GSet:
+    s = gset.g_empty(32)
+    for _ in range(rng.integers(0, 8)):
+        s = gset.g_add(s, int(rng.integers(0, 12)))
+    return s
+
+
+def rand_tpset(rng: np.random.Generator) -> gset.TwoPSet:
+    s = gset.tp_empty(32)
+    for _ in range(rng.integers(0, 8)):
+        e = int(rng.integers(0, 12))
+        s = gset.tp_add(s, e) if rng.random() < 0.7 else gset.tp_remove(s, e)
+    return s
+
+
+CASES = [
+    ("mvregister", mv.join, rand_mv, lambda: mv.zero(W)),
+    ("ewflag", flags.ew_join, rand_ew, lambda: flags.ew_zero(W)),
+    ("dwflag", flags.dw_join, rand_dw, lambda: flags.dw_zero(W)),
+    ("gset", gset.g_join, rand_gset, lambda: gset.g_empty(32)),
+    ("tpset", gset.tp_join, rand_tpset, lambda: gset.tp_empty(32)),
+]
+
+
+@pytest.mark.parametrize("name,join,gen,zero", CASES,
+                         ids=[c[0] for c in CASES])
+def test_join_laws(name, join, gen, zero):
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    for _ in range(N_TRIALS):
+        a, b, c = gen(rng), gen(rng), gen(rng)
+        assert tree_equal(join(a, b), join(b, a)), "commutativity"
+        assert tree_equal(join(join(a, b), c), join(a, join(b, c))), \
+            "associativity"
+        assert tree_equal(join(a, a), a), "idempotence"
+        assert tree_equal(join(a, zero()), a), "identity"
+
+
+def _arb_mv(rng: np.random.Generator) -> mv.MVRegister:
+    return mv.MVRegister(
+        seq=np.asarray(rng.integers(-1, 5, (W,)), np.int32),
+        ts=np.asarray(rng.integers(0, 50, (W,)), np.int32),
+        payload=np.asarray(rng.integers(0, 1000, (W,)), np.int32),
+        obs=np.asarray(rng.integers(-1, 5, (W, W)), np.int32),
+    )
+
+
+def _arb_plane(rng: np.random.Generator) -> flags.TokenPlane:
+    return flags.TokenPlane(
+        tok=np.asarray(rng.integers(-1, 5, (W,)), np.int32),
+        obs=np.asarray(rng.integers(-1, 5, (W, W)), np.int32),
+    )
+
+
+ARB_CASES = [
+    ("mvregister_arb", mv.join, _arb_mv, lambda: mv.zero(W)),
+    ("ewflag_arb", flags.ew_join,
+     lambda rng: flags.EWFlag(plane=_arb_plane(rng)),
+     lambda: flags.ew_zero(W)),
+    ("dwflag_arb", flags.dw_join,
+     lambda rng: flags.DWFlag(plane=_arb_plane(rng),
+                              touched=bool(rng.random() < 0.5)),
+     lambda: flags.dw_zero(W)),
+]
+
+
+@pytest.mark.parametrize("name,join,gen,zero", ARB_CASES,
+                         ids=[c[0] for c in ARB_CASES])
+def test_join_laws_arbitrary_states(name, join, gen, zero):
+    """Joins that are total functions of ANY state (not just reachable ones)
+    must satisfy the lattice laws unconditionally — this is what makes the
+    mvregister tie-break (elementwise max on equal seqs) load-bearing."""
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    for _ in range(N_TRIALS):
+        a, b, c = gen(rng), gen(rng), gen(rng)
+        assert tree_equal(join(a, b), join(b, a)), "commutativity"
+        assert tree_equal(join(join(a, b), c), join(a, join(b, c))), \
+            "associativity"
+        assert tree_equal(join(a, a), a), "idempotence"
+
+
+# ---- MV-Register semantics --------------------------------------------------
+
+
+def test_mv_concurrent_writes_both_visible():
+    a = mv.write(mv.zero(W), 0, ts=10, payload=100)
+    b = mv.write(mv.zero(W), 1, ts=11, payload=200)
+    m = mv.join(a, b)
+    vis, payload = mv.values(m)
+    assert list(np.asarray(vis)) == [True, True, False, False]
+    assert int(mv.n_siblings(m)) == 2
+    assert {int(p) for p, v in zip(np.asarray(payload), np.asarray(vis)) if v} \
+        == {100, 200}
+
+
+def test_mv_covering_write_collapses_siblings():
+    a = mv.write(mv.zero(W), 0, ts=10, payload=100)
+    b = mv.write(mv.zero(W), 1, ts=11, payload=200)
+    m = mv.join(a, b)            # {100, 200} are siblings
+    m = mv.write(m, 2, ts=12, payload=300)  # observed both
+    vis, payload = mv.values(m)
+    assert int(mv.n_siblings(m)) == 1
+    assert int(payload[np.asarray(vis).nonzero()[0][0]]) == 300
+
+
+def test_mv_sequential_overwrite_same_writer():
+    r = mv.write(mv.zero(W), 0, ts=1, payload=1)
+    r = mv.write(r, 0, ts=2, payload=2)
+    vis, payload = mv.values(r)
+    assert int(mv.n_siblings(r)) == 1
+    assert int(payload[0]) == 2 and bool(vis[0])
+
+
+def test_mv_stale_writer_dominated_after_merge():
+    a = mv.write(mv.zero(W), 0, ts=1, payload=1)
+    b = mv.join(mv.zero(W), a)          # replica b observes a's write
+    b = mv.write(b, 1, ts=2, payload=2)  # covers it
+    m = mv.join(a, b)
+    assert int(mv.n_siblings(m)) == 1
+    vis, payload = mv.values(m)
+    assert int(payload[1]) == 2 and bool(vis[1]) and not bool(vis[0])
+
+
+def test_mv_batched_vmap():
+    regs = mv.zero(W, batch=(8,))
+    regs = jax.vmap(lambda r, p: mv.write(r, 0, 5, p))(
+        regs, jax.numpy.arange(8, dtype=jax.numpy.int32)
+    )
+    out = jax.vmap(mv.join)(regs, regs)
+    assert list(np.asarray(mv.n_siblings(out))) == [1] * 8
+
+
+# ---- flag semantics ---------------------------------------------------------
+
+
+def test_ew_concurrent_enable_wins():
+    base = flags.ew_zero(W)
+    ena = flags.ew_enable(base, 0)
+    dis = flags.ew_disable(base, 1)  # concurrent: never saw the enable
+    assert bool(flags.ew_value(flags.ew_join(ena, dis)))
+
+
+def test_ew_observed_disable_wins_sequentially():
+    f = flags.ew_enable(flags.ew_zero(W), 0)
+    f = flags.ew_disable(f, 1)  # saw the enable
+    assert not bool(flags.ew_value(f))
+    f = flags.ew_enable(f, 0)   # re-enable with a fresh token
+    assert bool(flags.ew_value(f))
+
+
+def test_dw_concurrent_disable_wins():
+    base = flags.dw_enable(flags.dw_zero(W), 0)
+    ena = flags.dw_enable(base, 0)
+    dis = flags.dw_disable(base, 1)  # concurrent with the re-enable
+    assert not bool(flags.dw_value(flags.dw_join(ena, dis)))
+
+
+def test_dw_initial_false_and_sequential_toggle():
+    f = flags.dw_zero(W)
+    assert not bool(flags.dw_value(f))
+    f = flags.dw_enable(f, 0)
+    assert bool(flags.dw_value(f))
+    f = flags.dw_disable(f, 1)
+    assert not bool(flags.dw_value(f))
+    f = flags.dw_enable(f, 0)  # observed the disable: clears it
+    assert bool(flags.dw_value(f))
+
+
+def test_flag_swarm_pure_max_converge():
+    """Flags are pure max-lattices: the swarm converge path works as-is."""
+    from crdt_tpu.parallel import swarm
+
+    r = 8
+    state = flags.ew_zero(W, batch=(r,))
+    state = flags.EWFlag(
+        plane=state.plane.replace(
+            tok=state.plane.tok.at[3, 0].set(0)  # replica 3 enables
+        )
+    )
+    s = swarm.make(state)
+    s = swarm.converge(
+        s, jax.vmap(flags.ew_join), flags.ew_zero(W)
+    )
+    assert all(bool(v) for v in np.asarray(flags.ew_value(s.state)))
+
+
+# ---- G-Set / 2P-Set semantics ----------------------------------------------
+
+
+def test_gset_grow_only_union():
+    a = gset.g_add(gset.g_add(gset.g_empty(16), 3), 7)
+    b = gset.g_add(gset.g_add(gset.g_empty(16), 7), 9)
+    u = gset.g_join(a, b)
+    assert int(gset.g_size(u)) == 3
+    for e in (3, 7, 9):
+        assert bool(gset.g_contains(u, e))
+
+
+def test_gset_duplicate_add_noop():
+    s = gset.g_add(gset.g_add(gset.g_empty(8), 5), 5)
+    assert int(gset.g_size(s)) == 1
+
+
+def test_tpset_remove_wins_forever():
+    s = gset.tp_add(gset.tp_empty(16), 1)
+    s = gset.tp_remove(s, 1)
+    assert not bool(gset.tp_contains(s, 1))
+    s = gset.tp_add(s, 1)  # two-phase: re-add is a no-op
+    assert not bool(gset.tp_contains(s, 1))
+
+
+def test_tpset_concurrent_add_remove():
+    a = gset.tp_add(gset.tp_empty(16), 1)
+    b = gset.tp_remove(gset.tp_empty(16), 1)  # remove without observing
+    m = gset.tp_join(a, b)
+    assert not bool(gset.tp_contains(m, 1))  # remove-wins
+    assert int(gset.tp_size(m)) == 0
+
+
+def test_tpset_overflow_checked():
+    a = gset.tp_empty(4)
+    for e in range(4):
+        a = gset.tp_add(a, e)
+    b = gset.tp_add(gset.tp_empty(4), 99)
+    _, n = gset.tp_join_checked(a, b)
+    assert int(n) == 5  # true union exceeds capacity: detectable host-side
