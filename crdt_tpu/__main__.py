@@ -97,21 +97,24 @@ def run_daemon(args) -> int:
     from crdt_tpu.api.net import NodeHost
     from crdt_tpu.utils.config import ClusterConfig
 
-    if args.compact_every:
-        # a compaction barrier needs a swarm-stable frontier agreed across
-        # every replica; the cross-daemon barrier protocol is not built yet,
-        # so refuse rather than silently grow the log forever
-        print("--compact-every is not supported in --daemon mode "
-              "(needs a cross-process barrier; use the demo/cluster mode)",
+    if args.compact_every and not args.coordinator:
+        # barriers must come from exactly one member (network_compact's
+        # single-scheduler rule); a non-coordinator daemon still folds when
+        # the coordinator's barrier reaches it (POST /compact or gossip
+        # frontier adoption), so refuse the ambiguous flag combination
+        print("--compact-every in --daemon mode requires --coordinator "
+              "(exactly one daemon in the fleet schedules barriers)",
               file=sys.stderr)
         return 2
     cfg = ClusterConfig(
         gossip_period_ms=args.gossip_ms,
+        compact_every=args.compact_every,
         delta_gossip=not args.full_gossip,
     )
     peers = [u for u in (args.peers or "").split(",") if u]
     host = NodeHost(
-        rid=args.rid, peers=peers, port=args.port, config=cfg
+        rid=args.rid, peers=peers, port=args.port, config=cfg,
+        coordinator=args.coordinator,
     )
     host.start()
     print(f"replica rid={args.rid} serving on {host.url}, "
@@ -167,6 +170,9 @@ def main(argv=None) -> int:
                     help="daemon: listen port (0 = ephemeral)")
     ap.add_argument("--peers", type=str, default="",
                     help="daemon: comma-separated peer base URLs")
+    ap.add_argument("--coordinator", action="store_true",
+                    help="daemon: schedule cross-fleet compaction barriers "
+                         "from this process (exactly one per fleet)")
     ap.add_argument("--platform", choices=["cpu", "tpu", "ambient"],
                     default="cpu",
                     help="JAX backend for the host runtime (default cpu: "

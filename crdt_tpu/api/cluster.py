@@ -16,7 +16,7 @@ import random
 import threading
 from typing import Dict, List, Optional
 
-from crdt_tpu.api.node import ReplicaNode, pull_round
+from crdt_tpu.api.node import ReplicaNode, pull_round, stable_frontier_host
 from crdt_tpu.utils.clock import HostClock
 from crdt_tpu.utils.config import ClusterConfig
 from crdt_tpu.utils.metrics import Metrics
@@ -108,21 +108,17 @@ class LocalCluster:
             alive = [n for n in self.nodes if n.alive]
             if not alive:
                 return {}
-            vvs = [n.version_vector() for n in alive]
-            rids = set().union(*vvs)
-            frontier = {
-                r: s
-                for r in rids
-                if (s := min(vv.get(r, -1) for vv in vvs)) >= 0
-            }
-            for n in self.nodes:
-                for r, s in n.frontier.items():
-                    if frontier.get(r, -1) < s:
-                        self.metrics.inc("compact_skipped")
-                        return {}
-            if frontier:
-                for n in alive:
-                    n.compact(frontier)
+            # chain rule spans ALL nodes (dead included): a dead node's fold
+            # may be the only copy of what it folded (see docstring)
+            frontier = stable_frontier_host(
+                [n.version_vector() for n in alive],
+                [n.frontier for n in self.nodes],
+            )
+            if not frontier:
+                self.metrics.inc("compact_skipped")
+                return {}
+            for n in alive:
+                n.compact(frontier)
             return frontier
 
     def converged(self) -> bool:

@@ -8,6 +8,11 @@ Routes (1:1 with /root/reference/main.go:262-266):
   POST /data                    append command, "Inserted" (main.go:173-215)
   GET  /condition/<bool>        set alive                  (main.go:141-152)
 
+Framework extensions (not part of the Go surface; used by the cross-daemon
+compaction barrier, crdt_tpu.api.net.network_compact):
+  GET  /vv                      {"vv": {rid: seq}, "frontier": {rid: seq}}
+  POST /compact                 {"frontier": {rid: seq}} -> fold + prune
+
 The /condition route takes the flag as a path segment (also accepted:
 ?alive_status=) — the reference registered the route without the parameter
 binding so every call 500'd (quirk §0.1.7); this shim implements what that
@@ -78,6 +83,16 @@ def _make_handler(cluster: LocalCluster, idx: int):
                     self._send(502, "Unreachable")
                 else:
                     self._send(200, json.dumps(payload), "application/json")
+            elif url.path == "/vv":
+                if not self.node.alive:
+                    self._send(502, "Unreachable")
+                    return
+                vv, frontier = self.node.vv_snapshot()  # one lock: consistent pair
+                body = {
+                    "vv": {str(r): s for r, s in vv.items()},
+                    "frontier": {str(r): s for r, s in frontier.items()},
+                }
+                self._send(200, json.dumps(body), "application/json")
             elif parts and parts[0] == "condition":
                 flag = None
                 if len(parts) > 1:
@@ -94,7 +109,25 @@ def _make_handler(cluster: LocalCluster, idx: int):
                 self._send(404, "not found")
 
         def do_POST(self):
-            if urlparse(self.path).path != "/data":
+            path = urlparse(self.path).path
+            if path == "/compact":
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    frontier = {
+                        int(r): int(s)
+                        for r, s in (body.get("frontier") or {}).items()
+                    }
+                except Exception:
+                    self._send(400, "invalid frontier")
+                    return
+                if not self.node.alive:
+                    self._send(502, "Unreachable")
+                    return
+                self.node.compact(frontier)
+                self._send(200, "OK")
+                return
+            if path != "/data":
                 self._send(404, "not found")
                 return
             n = int(self.headers.get("Content-Length", 0))

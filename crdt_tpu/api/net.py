@@ -28,10 +28,11 @@ import threading
 import urllib.error
 import urllib.parse
 import urllib.request
+from concurrent.futures import ThreadPoolExecutor
 from http.server import ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from crdt_tpu.api.node import ReplicaNode, pull_round
+from crdt_tpu.api.node import ReplicaNode, pull_round, stable_frontier_host
 from crdt_tpu.utils.config import ClusterConfig
 from crdt_tpu.utils.metrics import Metrics
 
@@ -51,6 +52,19 @@ class RemotePeer:
                 return res.read() if res.status == 200 else None
         except (urllib.error.URLError, OSError):
             return None  # unreachable/dead peer: caller skips (main.go:235-239)
+
+    def _post(self, path: str, body: dict) -> bool:
+        req = urllib.request.Request(
+            self.url + path,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as res:
+                return res.status == 200
+        except (urllib.error.URLError, OSError):
+            return False
 
     def ping(self) -> bool:
         """GET /ping (main.go:115-127)."""
@@ -75,21 +89,68 @@ class RemotePeer:
 
     def add_command(self, cmd: Dict[str, str]) -> bool:
         """POST /data (main.go:173-215)."""
-        req = urllib.request.Request(
-            self.url + "/data",
-            data=json.dumps(cmd).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as res:
-                return res.status == 200
-        except (urllib.error.URLError, OSError):
-            return False
+        return self._post("/data", cmd)
 
     def set_alive(self, alive: bool) -> bool:
         """GET /condition/<bool> (main.go:141-152, routing fixed §0.1.7)."""
         return self._get(f"/condition/{str(bool(alive)).lower()}") is not None
+
+    def version_vector(self):
+        """GET /vv → ({rid: seq} received watermark, {rid: seq} folded
+        frontier), or None when down/unreachable."""
+        body = self._get("/vv")
+        if body is None:
+            return None
+        d = json.loads(body)
+        return (
+            {int(r): int(s) for r, s in (d.get("vv") or {}).items()},
+            {int(r): int(s) for r, s in (d.get("frontier") or {}).items()},
+        )
+
+    def compact(self, frontier: Dict[int, int]) -> bool:
+        """POST /compact: fold everything at or under ``frontier``."""
+        return self._post(
+            "/compact",
+            {"frontier": {str(r): s for r, s in frontier.items()}},
+        )
+
+
+def network_compact(node: ReplicaNode, peers: List[RemotePeer]) -> Dict[int, int]:
+    """One cross-daemon compaction barrier (the network analogue of
+    LocalCluster.compact): agree on the swarm-stable frontier and tell every
+    member to fold it.
+
+    The frontier is the per-writer min over ALL members' version vectors —
+    every member provably holds everything under it.  If ANY peer is
+    unreachable the barrier is skipped (returns {}): an unseen member might
+    lack ops under the candidate frontier, and (chain rule) its existing
+    fold must stay dominated — same reasoning as the dead-node rule in
+    LocalCluster.compact.  Run from ONE coordinator only: two concurrent
+    coordinators could mint incomparable frontiers (the same single-
+    scheduler rule as LocalCluster's replica-0 loop).
+
+    A member that misses the /compact POST (crash between the vv collection
+    and the fold) catches up by adopting the frontier+summary sections from
+    any folded peer's gossip payload (ReplicaNode._adopt_frontier_locked).
+    """
+    own_vv, own_frontier = node.vv_snapshot()
+    vvs, frontiers = [own_vv], [own_frontier]
+    with ThreadPoolExecutor(max_workers=max(len(peers), 1)) as pool:
+        # per-peer calls are independent: collect concurrently so one slow
+        # member costs one timeout, not N (the coordinator's gossip loop is
+        # blocked for the duration of the barrier)
+        for got in pool.map(lambda p: p.version_vector(), peers):
+            if got is None:
+                return {}  # unreachable member: cannot prove stability
+            vvs.append(got[0])
+            frontiers.append(got[1])
+        frontier = stable_frontier_host(vvs, frontiers)
+        if not frontier:
+            return {}
+        node.compact(frontier)
+        # a missed POST self-heals via gossip frontier adoption
+        list(pool.map(lambda p: p.compact(frontier), peers))
+    return frontier
 
 
 class NetworkAgent:
@@ -109,11 +170,15 @@ class NetworkAgent:
         config: Optional[ClusterConfig] = None,
         metrics: Optional[Metrics] = None,
         seed: Optional[int] = None,
+        coordinator: bool = False,
     ):
         self.node = node
         self.peers = [RemotePeer(u) for u in peer_urls]
         self.config = config or ClusterConfig()
         self.metrics = metrics or node.metrics
+        # compaction-barrier scheduler: exactly ONE agent in the fleet may
+        # coordinate (see network_compact's single-scheduler rule)
+        self.coordinator = coordinator
         self._rng = random.Random(self.config.seed if seed is None else seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -146,11 +211,25 @@ class NetworkAgent:
         if self.errors:
             raise RuntimeError("network gossip loop died") from self.errors[0]
 
+    def compact_once(self) -> dict:
+        """Run one cross-daemon compaction barrier from this agent (must be
+        the fleet's single coordinator)."""
+        frontier = network_compact(self.node, self.peers)
+        self.metrics.inc(
+            "net_compactions" if frontier else "net_compact_skipped"
+        )
+        return frontier
+
     def _loop(self) -> None:
         period = self.config.gossip_period_ms / 1000.0
+        rounds = 0
+        every = self.config.compact_every
         while not self._stop.wait(period):
             try:
                 self.gossip_once()
+                rounds += 1
+                if self.coordinator and every and rounds % every == 0:
+                    self.compact_once()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.metrics.inc("net_gossip_loop_errors")
                 self.errors.append(e)
@@ -179,6 +258,7 @@ class NodeHost:
         host: str = "127.0.0.1",
         config: Optional[ClusterConfig] = None,
         capacity: Optional[int] = None,
+        coordinator: bool = False,
     ):
         from crdt_tpu.api.http_shim import _make_handler
 
@@ -187,7 +267,9 @@ class NodeHost:
             rid=rid, capacity=capacity or self.config.log_capacity
         )
         self.nodes = [self.node]  # duck-types as a cluster for the handler
-        self.agent = NetworkAgent(self.node, peers, self.config)
+        self.agent = NetworkAgent(
+            self.node, peers, self.config, coordinator=coordinator
+        )
         self._server = ThreadingHTTPServer(
             (host, port), _make_handler(self, 0)
         )

@@ -72,6 +72,26 @@ def _parse_wire_key(k: str) -> Tuple[int, int, int]:
     return int(k), -1, 0  # Go-format key: millisecond timestamp only
 
 
+def stable_frontier_host(vvs, frontiers) -> Dict[int, int]:
+    """The host-side stable-frontier computation shared by every barrier
+    scheduler (LocalCluster.compact, net.network_compact): the per-writer
+    min over the member version vectors ``vvs``, valid only if it dominates
+    every existing fold in ``frontiers`` (the chain rule — a non-dominating
+    barrier would mint an incomparable frontier generation).  Returns {}
+    when no barrier is possible this round."""
+    rids = set().union(*vvs)
+    frontier = {
+        r: s
+        for r in rids
+        if (s := min(vv.get(r, -1) for vv in vvs)) >= 0
+    }
+    for f in frontiers:
+        for r, s in f.items():
+            if frontier.get(r, -1) < s:
+                return {}
+    return frontier
+
+
 def pull_round(node: "ReplicaNode", fetch_payload, metrics, delta: bool,
                prefix: str = "gossip") -> bool:
     """One anti-entropy pull into ``node`` — the shared round body of every
@@ -206,6 +226,14 @@ class ReplicaNode:
         held (folded or raw).  The delta-gossip request token."""
         with self._lock:
             return self._version_vector_locked()
+
+    def vv_snapshot(self) -> Tuple[Dict[int, int], Dict[int, int]]:
+        """(version vector, folded frontier) under ONE lock acquisition —
+        barrier coordinators need the pair to be mutually consistent (a
+        frontier adopted between two separate reads would report a frontier
+        ahead of the vv and spuriously fail the chain-rule check)."""
+        with self._lock:
+            return self._version_vector_locked(), dict(self._frontier)
 
     @property
     def frontier(self) -> Dict[int, int]:

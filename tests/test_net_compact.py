@@ -1,0 +1,142 @@
+"""Cross-daemon compaction barrier tests (crdt_tpu.api.net.network_compact):
+the distributed version of the LocalCluster barrier — version vectors
+collected over HTTP, the swarm-stable frontier POSTed back, misses healed
+by gossip frontier adoption.  (The reference never prunes at all:
+/root/reference/main.go:75 clears only its staging buffer.)"""
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from crdt_tpu.api.net import NodeHost, RemotePeer, network_compact
+from crdt_tpu.models import oplog
+
+
+@pytest.fixture
+def trio():
+    """Three served NodeHosts, fully peered, agents driven manually."""
+    hosts = [NodeHost(rid=r, peers=[]) for r in range(3)]
+    for h in hosts:
+        h.agent.peers = [RemotePeer(o.url) for o in hosts if o is not h]
+        threading.Thread(target=h._server.serve_forever, daemon=True).start()
+    yield hosts
+    for h in hosts:
+        h._server.shutdown()
+        h._server.server_close()
+
+
+def _converge(hosts, rounds=8):
+    for _ in range(rounds):
+        for h in hosts:
+            h.agent.gossip_once()
+
+
+def test_vv_endpoint(trio):
+    a = trio[0]
+    RemotePeer(a.url).add_command({"x": "1"})
+    vv, frontier = RemotePeer(a.url).version_vector()
+    assert vv == {0: 0} and frontier == {}
+    a.node.set_alive(False)
+    assert RemotePeer(a.url).version_vector() is None
+
+
+def test_network_barrier_folds_everyone(trio):
+    a, b, c = trio
+    RemotePeer(a.url).add_command({"x": "5"})
+    RemotePeer(b.url).add_command({"x": "2"})
+    RemotePeer(c.url).add_command({"y": "hi"})
+    _converge(trio)
+    states = [h.node.get_state() for h in trio]
+    assert states[0] == states[1] == states[2] == {"x": "7", "y": "hi"}
+
+    frontier = network_compact(a.node, a.agent.peers)
+    assert frontier == {0: 0, 1: 0, 2: 0}
+    for h in trio:
+        assert int(oplog.size(h.node.log)) == 0  # fully folded
+        assert h.node._commands == {}
+        assert h.node.get_state() == {"x": "7", "y": "hi"}  # unchanged
+    # writes keep flowing after the fold
+    RemotePeer(b.url).add_command({"x": "1"})
+    _converge(trio)
+    assert all(h.node.get_state()["x"] == "8" for h in trio)
+
+
+def test_barrier_skipped_when_member_unreachable(trio):
+    a, b, c = trio
+    RemotePeer(a.url).add_command({"x": "5"})
+    _converge(trio)
+    c.node.set_alive(False)  # /vv now 502s
+    assert network_compact(a.node, a.agent.peers) == {}
+    for h in trio:
+        assert h.node.frontier == {}  # nobody folded
+
+
+def test_missed_compact_post_heals_via_gossip(trio):
+    """A member whose POST /compact is lost (crash/drop between the vv
+    collection and the fold) adopts the frontier+summary from any folded
+    peer's gossip payload."""
+    a, b, c = trio
+    RemotePeer(a.url).add_command({"x": "5"})
+    RemotePeer(b.url).add_command({"y": "2"})
+    _converge(trio)
+    # the coordinator computed the barrier over ALL members (everyone
+    # converged, so every vv agrees), but c's POST got lost: only a and b
+    # fold now
+    frontier = {0: 0, 1: 0}
+    a.node.compact(frontier)
+    assert RemotePeer(b.url).compact(frontier)
+    assert c.node.frontier == {}
+    # c still holds every raw op, so delta gossip rightly ships it nothing
+    # (its vv covers the peers' frontier — no sections needed); its state
+    # stays correct and the NEXT barrier folds it too
+    for _ in range(4):
+        c.agent.gossip_once()
+    assert c.node.get_state() == a.node.get_state()
+    RemotePeer(c.url).add_command({"z": "9"})
+    _converge(trio)
+    frontier2 = network_compact(a.node, a.agent.peers)
+    assert frontier2 == {0: 0, 1: 0, 2: 0}
+    assert c.node.frontier == frontier2
+    assert all(h.node.get_state() == a.node.get_state() for h in trio)
+
+    # the sections DO ship to a requester that actually lacks ops: a fresh
+    # member joining after the fold reconstructs full state from them
+    fresh = NodeHost(rid=9, peers=[a.url])
+    threading.Thread(target=fresh._server.serve_forever, daemon=True).start()
+    try:
+        fresh.agent.peers = [RemotePeer(a.url)]
+        assert fresh.agent.gossip_once()
+        assert fresh.node.frontier == frontier2
+        assert fresh.node.get_state() == a.node.get_state()
+    finally:
+        fresh._server.shutdown()
+        fresh._server.server_close()
+
+
+def test_coordinator_loop_compacts(trio):
+    a, b, c = trio
+    for h in trio:
+        h.config.gossip_period_ms = 30
+        h.agent.config.gossip_period_ms = 30
+    a.agent.config.compact_every = 3
+    a.agent.coordinator = True
+    RemotePeer(a.url).add_command({"x": "5"})
+    RemotePeer(b.url).add_command({"x": "-2"})
+    for h in trio:
+        h.agent.start()
+    try:
+        import time
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if all(h.node.frontier for h in trio) and all(
+                h.node.get_state() == {"x": "3"} for h in trio
+            ):
+                break
+            time.sleep(0.05)
+        assert all(h.node.get_state() == {"x": "3"} for h in trio)
+        assert all(h.node.frontier for h in trio)
+    finally:
+        for h in trio:
+            h.agent.stop()
