@@ -213,7 +213,12 @@ class NetworkAgent:
 
     def compact_once(self) -> dict:
         """Run one cross-daemon compaction barrier from this agent (must be
-        the fleet's single coordinator)."""
+        the fleet's single coordinator).  A dead coordinator schedules
+        nothing — same fault model as every other surface (GET /vv and
+        POST /compact 502 when dead; LocalCluster folds alive nodes only)."""
+        if not self.node.alive:
+            self.metrics.inc("net_compact_skipped")
+            return {}
         frontier = network_compact(self.node, self.peers)
         self.metrics.inc(
             "net_compactions" if frontier else "net_compact_skipped"
@@ -223,11 +228,11 @@ class NetworkAgent:
     def _loop(self) -> None:
         period = self.config.gossip_period_ms / 1000.0
         rounds = 0
-        every = self.config.compact_every
         while not self._stop.wait(period):
             try:
                 self.gossip_once()
                 rounds += 1
+                every = self.config.compact_every  # re-read: live reconfig
                 if self.coordinator and every and rounds % every == 0:
                     self.compact_once()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()

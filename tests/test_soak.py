@@ -1,0 +1,48 @@
+"""CI wrapper for the Jepsen-lite soak (crdt_tpu.harness.soak): short
+randomized schedules across seeds and configurations.  The invariants (I1
+durability, I2 availability, I3 liveness, I4 schedule safety) are asserted
+inside the runner; these tests choose adversarial configurations."""
+import pytest
+
+from crdt_tpu.harness.soak import SoakRunner
+from crdt_tpu.utils.config import ClusterConfig
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_soak_basic(seed):
+    r = SoakRunner(seed=seed).run(300)
+    assert r.writes_accepted > 0
+    assert r.final_state  # something survived to the fixpoint
+
+
+def test_soak_with_scheduled_compaction():
+    """Barriers racing faults: compaction every few ticks while nodes die
+    and revive — the frontier chain rule must keep every schedule legal."""
+    cfg = ClusterConfig(n_replicas=5, compact_every=0)
+    r = SoakRunner(cfg, seed=7, p_compact=0.15).run(400)
+    assert r.barriers + r.barriers_skipped > 0
+    assert r.final_state
+
+
+def test_soak_full_gossip_mode():
+    cfg = ClusterConfig(n_replicas=4, delta_gossip=False)
+    r = SoakRunner(cfg, seed=3).run(250)
+    assert r.final_state
+
+
+def test_soak_aggressive_faults():
+    """Kill-heavy schedule: up to n-1 dead at once, many revivals."""
+    r = SoakRunner(
+        seed=11, p_write=0.3, p_gossip=0.3, p_kill=0.2, p_revive=0.15,
+        p_compact=0.05,
+    ).run(400)
+    assert r.kills >= 5 and r.revivals >= 5
+    assert r.writes_rejected_dead > 0  # I2 actually exercised
+    assert r.final_state
+
+
+def test_soak_reference_topology():
+    """The reference's own friend list (self + dead ports, quirk §0.1.9)."""
+    cfg = ClusterConfig(n_replicas=5, reference_topology=True)
+    r = SoakRunner(cfg, seed=5).run(300)
+    assert r.final_state
