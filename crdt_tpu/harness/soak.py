@@ -149,6 +149,20 @@ class SoakRunner:
         else:
             self.report.barriers_skipped += 1
 
+    def _tick(self) -> None:
+        """A full cluster tick: one pull per replica AND the tick-scheduled
+        compaction path (config.compact_every) — so scheduled barriers race
+        the fault schedule, not just the explicit p_compact barriers."""
+        before = self.cluster.metrics.snapshot()
+        self.report.gossip_rounds += self.cluster.tick()
+        after = self.cluster.metrics.snapshot()
+        self.report.barriers += (
+            after.get("compactions", 0) > before.get("compactions", 0)
+        )
+        self.report.barriers_skipped += (
+            after.get("compact_skipped", 0) - before.get("compact_skipped", 0)
+        ) > 0
+
     # ---- run ----
 
     def step(self) -> None:
@@ -165,7 +179,7 @@ class SoakRunner:
         elif x < p_write + p_gossip + p_kill + p_revive + p_compact:
             self._compact()
         else:
-            pass  # idle tick (clock advances between writes anyway)
+            self._tick()  # full round incl. the SCHEDULED compaction path
         self.report.steps += 1
 
     def heal_and_check(self, max_rounds: int = 400) -> SoakReport:

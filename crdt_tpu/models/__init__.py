@@ -8,4 +8,5 @@ from crdt_tpu.models import (  # noqa: F401
     oplog,
     orset,
     pncounter,
+    rseq,
 )

@@ -1,0 +1,279 @@
+"""RSeq: replicated sequence (list) CRDT, array-encoded for TPU.
+
+The reference has no sequence type; a complete CRDT framework ships one (the
+collaborative-editing family: RGA / Logoot / Treedoc).  This design keeps
+the framework's sorted-tensor shape — the state is a sorted, SENTINEL-
+padded fixed-capacity table and the join is a multi-key sorted-segment
+union — by giving every element a flat-sortable **two-level position key**:
+
+    level 1:  (pos1, rid1, seq1)   a 60-bit coordinate + an identity
+    level 2:  (pos2, rid2, seq2)
+
+* A **top-level insert** allocates ``pos1`` between its neighbours'
+  coordinates (appends stride by APPEND_STRIDE so the common case never
+  bisects; interior inserts take the midpoint) and stamps BOTH levels with
+  its own identity, ``pos2 = MID``.
+* When the level-1 gap is exhausted — most commonly because two writers
+  concurrently inserted into the same gap, got the same midpoint, and were
+  tie-broken by (rid, seq) — the insert goes **deep**: it anchors on the
+  LEFT neighbour (level 1 = the neighbour's level-1 triple, copied) and
+  allocates ``pos2 > MID`` between the deep neighbours under that anchor.
+  Lexicographic order then places it after its anchor and before the next
+  level-1 key, which is exactly the RGA insert-after rule.
+
+Concurrent inserts that collide at BOTH levels (same anchor, same pos2
+midpoint) are tie-broken by (rid2, seq2) and remain insertable-around via
+further deep inserts under the same anchor; the only unrepresentable
+pattern is a gap bisected to exhaustion at both levels (~60 nested
+midpoint collisions), which raises ``GapExhausted`` rather than silently
+mis-ordering — identities are immutable in a CRDT, so no rebalancing.
+
+Everything on-device is the standard machinery: join = 8-key sorted union
+with tombstone-OR (crdt_tpu.ops.sorted_union — the same engine as the op
+log, main.go:49-73's capability); delete = monotone tombstone; read = the
+non-tombstoned payloads in row order (the table IS the list).  Position
+allocation happens host-side at ingestion, like timestamps (never under
+jit)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from crdt_tpu.ops import sorted_union as su
+from crdt_tpu.utils.constants import SENTINEL
+
+POS_BITS = 60
+POS_MAX = 1 << POS_BITS          # exclusive virtual-coordinate bound
+MID = POS_MAX // 2               # level-2 coordinate of every top insert
+HALF_BITS = 30
+HALF_MASK = (1 << HALF_BITS) - 1
+APPEND_STRIDE = 1 << 20          # gap left after an append / before a prepend
+
+KEY_COLS = ("p1_hi", "p1_lo", "rid1", "seq1",
+            "p2_hi", "p2_lo", "rid2", "seq2")
+
+
+class GapExhausted(ValueError):
+    """No representable position remains between the two neighbours."""
+
+
+def split_pos(pos: int):
+    assert 0 <= pos < POS_MAX
+    return pos >> HALF_BITS, pos & HALF_MASK
+
+
+def join_pos(hi: int, lo: int) -> int:
+    return (int(hi) << HALF_BITS) | int(lo)
+
+
+def _alloc(lo: int, hi: int, *, stride_edges: bool) -> int:
+    """An integer strictly between lo and hi.  With stride_edges, stay
+    APPEND_STRIDE away from an open end so append/prepend runs cost O(1)
+    coordinate space per element instead of halving the gap."""
+    if hi - lo < 2:
+        raise GapExhausted(
+            f"no position left between {lo} and {hi}: nested-midpoint "
+            "collisions exhausted both levels (identities are immutable; "
+            "this needs ~60 adversarial collisions in one gap)"
+        )
+    if stride_edges and hi == POS_MAX and lo != -1 and lo + APPEND_STRIDE < hi:
+        return lo + APPEND_STRIDE           # append: don't bisect the tail
+    if stride_edges and lo == -1 and hi != POS_MAX and hi - APPEND_STRIDE > lo:
+        return hi - APPEND_STRIDE           # prepend: don't bisect the head
+    return (lo + hi) // 2                   # interior (and the first-ever
+    #                                         element: mid-space, so both
+    #                                         ends keep ~2^59 of room)
+
+
+@struct.dataclass
+class RSeq:
+    """Rows sorted by the 8 KEY_COLS; padding rows have every key column =
+    SENTINEL."""
+
+    p1_hi: jax.Array
+    p1_lo: jax.Array
+    rid1: jax.Array
+    seq1: jax.Array
+    p2_hi: jax.Array
+    p2_lo: jax.Array
+    rid2: jax.Array
+    seq2: jax.Array
+    elem: jax.Array     # int32[C]  payload id (host-interned)
+    removed: jax.Array  # bool[C]   tombstone (monotone)
+
+    @property
+    def capacity(self) -> int:
+        return self.p1_hi.shape[-1]
+
+
+def empty(capacity: int) -> RSeq:
+    s = jnp.full((capacity,), SENTINEL, jnp.int32)
+    return RSeq(**{c: s for c in KEY_COLS},
+                elem=jnp.zeros((capacity,), jnp.int32),
+                removed=jnp.zeros((capacity,), bool))
+
+
+def size(s: RSeq) -> jax.Array:
+    """Live (non-tombstoned, non-padding) element count."""
+    return jnp.sum((s.p1_hi != SENTINEL) & ~s.removed).astype(jnp.int32)
+
+
+def _keys(s: RSeq):
+    return tuple(getattr(s, c) for c in KEY_COLS)
+
+
+def _vals(s: RSeq):
+    return {"elem": s.elem, "removed": s.removed}
+
+
+def _combine(a, b):
+    # identical identity => identical element payload; tombstones OR
+    return {"elem": a["elem"], "removed": a["removed"] | b["removed"]}
+
+
+def _from_union(keys, vals) -> RSeq:
+    return RSeq(**dict(zip(KEY_COLS, keys)),
+                elem=vals["elem"], removed=vals["removed"])
+
+
+@jax.jit
+def join(a: RSeq, b: RSeq) -> RSeq:
+    out, _ = join_checked(a, b)
+    return out
+
+
+@jax.jit
+def join_checked(a: RSeq, b: RSeq):
+    """CRDT join: position-key union with tombstone-OR.  Same capacity
+    contract as every sorted lattice: a union exceeding capacity drops the
+    largest keys (detect via the returned count)."""
+    keys, vals, n = su.sorted_union(
+        _keys(a), _vals(a), _keys(b), _vals(b),
+        combine=_combine, out_size=a.capacity,
+    )
+    return _from_union(keys, vals), n
+
+
+@jax.jit
+def insert(s: RSeq, key, elem) -> RSeq:
+    """Insert one identified element (the 8-int ``key`` is allocated
+    host-side by SeqWriter/alloc_key).  Requires a free slot."""
+    one = RSeq(
+        **{c: jnp.full((1,), key[i], jnp.int32)
+           for i, c in enumerate(KEY_COLS)},
+        elem=jnp.full((1,), elem, jnp.int32),
+        removed=jnp.zeros((1,), bool),
+    )
+    keys, vals, _ = su.sorted_union(
+        _keys(s), _vals(s), _keys(one), _vals(one),
+        combine=_combine, out_size=s.capacity,
+    )
+    return _from_union(keys, vals)
+
+
+@jax.jit
+def delete(s: RSeq, key) -> RSeq:
+    """Tombstone one element by identity (RGA delete: the position stays)."""
+    hit = jnp.ones_like(s.removed)
+    for i, c in enumerate(KEY_COLS):
+        hit = hit & (getattr(s, c) == key[i])
+    return s.replace(removed=s.removed | hit)
+
+
+def to_list(s: RSeq):
+    """Host decode: live payload ids in sequence order."""
+    import numpy as np
+
+    live = (np.asarray(s.p1_hi) != int(SENTINEL)) & ~np.asarray(s.removed)
+    return [int(e) for e in np.asarray(s.elem)[live]]
+
+
+# ---- host-side identity allocation ------------------------------------------
+
+
+def _key_tuple(row):
+    """(p1, (rid1, seq1), p2, (rid2, seq2)) from an 8-int key row."""
+    return (
+        join_pos(row[0], row[1]), (row[2], row[3]),
+        join_pos(row[4], row[5]), (row[6], row[7]),
+    )
+
+
+def alloc_key(left, right, rid: int, seq: int):
+    """Allocate the 8-int position key for an element between ``left`` and
+    ``right`` (8-int key rows, or None for begin/end).
+
+    Level 1 first; when its integer gap is exhausted (e.g. two concurrent
+    midpoint inserts collided and sit tie-broken side by side) the element
+    anchors deep on the LEFT neighbour.
+    """
+    lt = _key_tuple(left) if left is not None else None
+    rt = _key_tuple(right) if right is not None else None
+
+    lo1 = lt[0] if lt is not None else -1
+    hi1 = rt[0] if rt is not None else POS_MAX
+    try:
+        p1 = _alloc(lo1, hi1, stride_edges=True)
+        return (*split_pos(p1), rid, seq, *split_pos(MID), rid, seq)
+    except GapExhausted:
+        if lt is None:
+            # no left neighbour to anchor on: deep-before is unrepresentable
+            raise
+    # deep insert: anchor = left's level-1 triple.  If left is itself a top
+    # row (it IS the anchor, sitting at pos2 == MID) the deep child goes
+    # anywhere above MID; if left is already deep under this anchor, above
+    # left's own pos2.  The right neighbour constrains pos2 only when it is
+    # a deep row under the SAME anchor (any other right key is level-1
+    # greater and unreachable by pos2).
+    anchor_pos, anchor_id = lt[0], lt[1]
+    left_is_top = lt[2] == MID and lt[1] == lt[3]
+    lo2 = MID if left_is_top else lt[2]
+    hi2 = (
+        rt[2]
+        if rt is not None and rt[0] == anchor_pos and rt[1] == anchor_id
+        else POS_MAX
+    )
+    p2 = _alloc(lo2, hi2, stride_edges=False)
+    return (*split_pos(anchor_pos), *anchor_id, *split_pos(p2), rid, seq)
+
+
+class SeqWriter:
+    """Host-side editing cursor for one writer: tracks identities so the
+    caller edits by INDEX (insert_at / delete_at) like a normal list, while
+    the CRDT below works on immutable position identities."""
+
+    def __init__(self, state: RSeq, rid: int):
+        self.state = state
+        self.rid = rid
+        self._seq = 0
+
+    def _live_keys(self):
+        """Ordered list of (key_row, row_index) for live elements."""
+        import numpy as np
+
+        cols = [np.asarray(getattr(self.state, c)) for c in KEY_COLS]
+        live = (cols[0] != int(SENTINEL)) & ~np.asarray(self.state.removed)
+        return [
+            (tuple(int(c[i]) for c in cols), i)
+            for i in np.nonzero(live)[0]
+        ]
+
+    def insert_at(self, index: int, elem: int) -> None:
+        rows = self._live_keys()
+        left = rows[index - 1][0] if index > 0 else None
+        right = rows[index][0] if index < len(rows) else None
+        seq = self._seq
+        self._seq += 1
+        key = alloc_key(left, right, self.rid, seq)
+        self.state = insert(self.state, key, elem)
+
+    def append(self, elem: int) -> None:
+        self.insert_at(len(self._live_keys()), elem)
+
+    def delete_at(self, index: int) -> None:
+        key = self._live_keys()[index][0]
+        self.state = delete(self.state, key)
+
+    def to_list(self):
+        return to_list(self.state)

@@ -1,0 +1,181 @@
+"""Sequence CRDT tests (crdt_tpu.models.rseq): join laws, RGA-style
+concurrent-edit semantics, and the host editing cursor."""
+import zlib
+
+import numpy as np
+import pytest
+
+from crdt_tpu.models import rseq
+from tests.helpers import tree_equal
+
+N_TRIALS = 20
+CAP = 64
+
+
+_next_rid = iter(range(10_000))
+
+
+def _rand_seq(rng: np.random.Generator) -> rseq.RSeq:
+    # each generated state gets a FRESH writer id: (rid, seq) identities
+    # must be globally writer-unique — the precondition every real
+    # deployment upholds (ClusterConfig.rid_base) — else two states could
+    # carry the same identity with different payloads
+    w = rseq.SeqWriter(rseq.empty(CAP), rid=next(_next_rid))
+    for _ in range(rng.integers(0, 10)):
+        n = len(w.to_list())
+        if n and rng.random() < 0.25:
+            w.delete_at(int(rng.integers(0, n)))
+        else:
+            w.insert_at(int(rng.integers(0, n + 1)), int(rng.integers(0, 100)))
+    return w.state
+
+
+def test_join_laws():
+    rng = np.random.default_rng(zlib.crc32(b"rseq"))
+    for _ in range(N_TRIALS):
+        a, b, c = _rand_seq(rng), _rand_seq(rng), _rand_seq(rng)
+        assert tree_equal(rseq.join(a, b), rseq.join(b, a)), "commutativity"
+        assert tree_equal(
+            rseq.join(rseq.join(a, b), c), rseq.join(a, rseq.join(b, c))
+        ), "associativity"
+        assert tree_equal(rseq.join(a, a), a), "idempotence"
+        assert tree_equal(rseq.join(a, rseq.empty(CAP)), a), "identity"
+
+
+def test_sequential_editing():
+    w = rseq.SeqWriter(rseq.empty(CAP), rid=0)
+    for ch in [10, 20, 30]:
+        w.append(ch)
+    assert w.to_list() == [10, 20, 30]
+    w.insert_at(1, 15)
+    assert w.to_list() == [10, 15, 20, 30]
+    w.delete_at(2)
+    assert w.to_list() == [10, 15, 30]
+    w.insert_at(0, 5)
+    assert w.to_list() == [5, 10, 15, 30]
+
+
+def test_concurrent_inserts_converge_deterministically():
+    """Two writers insert into the SAME gap concurrently: after exchanging
+    states both read the same list, ordered by writer id at the collision
+    point (the RGA interleaving rule)."""
+    base = rseq.SeqWriter(rseq.empty(CAP), rid=0)
+    base.append(1)
+    base.append(4)
+    a = rseq.SeqWriter(base.state, rid=1)
+    b = rseq.SeqWriter(base.state, rid=2)
+    a.insert_at(1, 2)   # both target the gap between 1 and 4
+    b.insert_at(1, 3)
+    merged_ab = rseq.join(a.state, b.state)
+    merged_ba = rseq.join(b.state, a.state)
+    assert rseq.to_list(merged_ab) == rseq.to_list(merged_ba)
+    assert rseq.to_list(merged_ab) == [1, 2, 3, 4]  # rid 1 before rid 2
+
+
+def test_concurrent_insert_and_delete():
+    base = rseq.SeqWriter(rseq.empty(CAP), rid=0)
+    for ch in [1, 2, 3]:
+        base.append(ch)
+    a = rseq.SeqWriter(base.state, rid=1)
+    b = rseq.SeqWriter(base.state, rid=2)
+    a.delete_at(1)      # remove 2
+    b.insert_at(2, 9)   # insert 9 between 2 and 3 (concurrent)
+    m = rseq.join(a.state, b.state)
+    assert rseq.to_list(m) == [1, 9, 3]  # delete won; insert survives
+    assert int(rseq.size(m)) == 3
+
+
+def test_delete_is_permanent_tombstone():
+    w = rseq.SeqWriter(rseq.empty(CAP), rid=0)
+    w.append(7)
+    before = w.state
+    w.delete_at(0)
+    # re-merging the pre-delete state cannot resurrect the element
+    m = rseq.join(w.state, before)
+    assert rseq.to_list(m) == []
+
+
+def test_interleaved_convergence_three_writers():
+    rng = np.random.default_rng(5)
+    base = rseq.empty(128)
+    writers = [rseq.SeqWriter(base, rid=r) for r in range(3)]
+    for step in range(30):
+        w = writers[rng.integers(0, 3)]
+        n = len(w.to_list())
+        if n and rng.random() < 0.3:
+            w.delete_at(int(rng.integers(0, n)))
+        else:
+            w.insert_at(int(rng.integers(0, n + 1)), int(rng.integers(0, 100)))
+        if step % 7 == 6:  # periodic pairwise gossip
+            i, j = rng.choice(3, size=2, replace=False)
+            m = rseq.join(writers[i].state, writers[j].state)
+            writers[i].state = m
+            writers[j].state = m
+    top = writers[0].state
+    for w in writers[1:]:
+        top = rseq.join(top, w.state)
+    for w in writers:
+        assert rseq.to_list(rseq.join(w.state, top)) == rseq.to_list(top)
+
+
+def test_insert_between_collided_pair():
+    """Regression: two writers concurrently insert into the same gap, get
+    the same level-1 midpoint (tie-broken by rid), and a third writer then
+    inserts BETWEEN the collided pair — this must go deep (anchor on the
+    left neighbour), not crash."""
+    base = rseq.SeqWriter(rseq.empty(CAP), rid=0)
+    base.append(1)
+    base.append(4)
+    a = rseq.SeqWriter(base.state, rid=1)
+    b = rseq.SeqWriter(base.state, rid=2)
+    a.insert_at(1, 2)
+    b.insert_at(1, 3)
+    m = rseq.SeqWriter(rseq.join(a.state, b.state), rid=3)
+    assert m.to_list() == [1, 2, 3, 4]
+    m.insert_at(2, 99)  # between the tie-broken twins: deep insert
+    assert m.to_list() == [1, 2, 99, 3, 4]
+    # and editing around the deep element keeps working
+    m.insert_at(3, 98)
+    assert m.to_list() == [1, 2, 99, 98, 3, 4]
+    m.insert_at(2, 97)
+    assert m.to_list() == [1, 2, 97, 99, 98, 3, 4]
+    m.delete_at(3)
+    assert m.to_list() == [1, 2, 97, 98, 3, 4]
+
+
+def test_deep_inserts_converge_across_writers():
+    """Deep (level-2) elements travel through joins like any other row."""
+    base = rseq.SeqWriter(rseq.empty(CAP), rid=0)
+    base.append(1)
+    base.append(4)
+    a = rseq.SeqWriter(base.state, rid=1)
+    b = rseq.SeqWriter(base.state, rid=2)
+    a.insert_at(1, 2)
+    b.insert_at(1, 3)
+    merged = rseq.join(a.state, b.state)
+    x = rseq.SeqWriter(merged, rid=3)
+    y = rseq.SeqWriter(merged, rid=4)
+    x.insert_at(2, 50)  # both go deep between the collided pair
+    y.insert_at(2, 60)
+    m1 = rseq.to_list(rseq.join(x.state, y.state))
+    m2 = rseq.to_list(rseq.join(y.state, x.state))
+    assert m1 == m2
+    assert m1 == [1, 2, 50, 60, 4] or m1 == [1, 2, 50, 60, 3, 4]
+    assert set(m1) == {1, 2, 3, 4, 50, 60}
+
+
+def test_gap_exhaustion_raises():
+    with pytest.raises(rseq.GapExhausted):
+        rseq._alloc(100, 101, stride_edges=False)
+    assert 100 < rseq._alloc(100, 103, stride_edges=False) < 103
+
+
+def test_append_and_prepend_use_stride_not_bisection():
+    w = rseq.SeqWriter(rseq.empty(256), rid=0)
+    for i in range(80):  # far more than 60-bit bisection could survive
+        w.append(i)
+    assert w.to_list() == list(range(80))
+    w2 = rseq.SeqWriter(rseq.empty(256), rid=1)
+    for i in range(80):
+        w2.insert_at(0, i)
+    assert w2.to_list() == list(range(79, -1, -1))
