@@ -40,7 +40,9 @@ def _make_handler(cluster: LocalCluster, idx: int):
             pass
 
         def _send(self, code: int, body: str, ctype: str = "text/plain"):
-            data = body.encode()
+            self._send_bytes(code, body.encode(), ctype)
+
+        def _send_bytes(self, code: int, data: bytes, ctype: str):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
@@ -78,11 +80,11 @@ def _make_handler(cluster: LocalCluster, idx: int):
                     except Exception:
                         self._send(400, "invalid vv")
                         return
-                payload = self.node.gossip_payload(since=since)
-                if payload is None:
+                body = self.node.gossip_payload_json(since=since)
+                if body is None:
                     self._send(502, "Unreachable")
                 else:
-                    self._send(200, json.dumps(payload), "application/json")
+                    self._send_bytes(200, body, "application/json")
             elif url.path == "/vv":
                 if not self.node.alive:
                     self._send(502, "Unreachable")

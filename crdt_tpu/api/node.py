@@ -19,6 +19,7 @@ is the shared-interner fast path.
 """
 from __future__ import annotations
 
+import json
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -146,10 +147,14 @@ class ReplicaNode:
             self.keys = native.NativeInterner()
             self.values = native.NativeInterner()
             self._packer = native.OpBatchPacker(self.keys, self.values)
+            # native mirror of the command map: gossip payload JSON is
+            # emitted in C++ straight from the interner arenas
+            self._wire = native.WireStore(self.keys, self.values)
         else:
             self.keys = Interner()
             self.values = Interner()
             self._packer = None
+            self._wire = None
         self.log = oplog.empty(capacity)
         self.alive = True
         self._seq = SeqGen()
@@ -272,40 +277,64 @@ class ReplicaNode:
         """
         if not self.alive:
             return None
-        epoch = self.clock.epoch_ms
         with self._lock:
-            if since is None:
-                # full dump of retained raw ops, ts-sorted like the
-                # reference's treemap JSON (main.go:159); Go-compatible only
-                # while this node has never compacted (see docstring)
-                payload: Dict[str, Any] = {
-                    _wire_key(k[0] + epoch, k[1], k[2]): dict(v)
-                    for k, v in sorted(self._commands.items())
-                }
-            else:
-                # delta: per-writer tail slices — O(|delta|), not O(history)
-                payload = {
-                    _wire_key(k[0] + epoch, k[1], k[2]): dict(v)
-                    for k, v in self._foreign
-                }
-                for w, lst in self._by_writer.items():
-                    if not lst:
-                        continue
-                    start = since.get(w, -1) + 1 - lst[0][0][2]
-                    for k, v in lst[max(start, 0):]:
-                        payload[_wire_key(k[0] + epoch, k[1], k[2])] = dict(v)
-            since = since or {}
-            frontier_covered = all(
-                since.get(r, -1) >= s for r, s in self._frontier.items()
-            )
-            if self._frontier and not frontier_covered:
-                payload[FRONTIER_KEY] = {
-                    str(r): s for r, s in self._frontier.items()
-                }
-                payload[SUMMARY_KEY] = {
-                    k: dict(e) for k, e in self._summary.items()
-                }
-            return payload
+            return self._payload_locked(since)
+
+    def _needs_sections_locked(self, since: Optional[Dict[int, int]]) -> bool:
+        """Must the payload carry the __frontier__/__summary__ sections?
+        (Yes when this node has folded past what ``since`` covers.)"""
+        since = since or {}
+        return bool(self._frontier) and not all(
+            since.get(r, -1) >= s for r, s in self._frontier.items()
+        )
+
+    def _payload_locked(self, since: Optional[Dict[int, int]]) -> Dict[str, Any]:
+        epoch = self.clock.epoch_ms
+        if since is None:
+            # full dump of retained raw ops, ts-sorted like the
+            # reference's treemap JSON (main.go:159); Go-compatible only
+            # while this node has never compacted (see docstring)
+            payload: Dict[str, Any] = {
+                _wire_key(k[0] + epoch, k[1], k[2]): dict(v)
+                for k, v in sorted(self._commands.items())
+            }
+        else:
+            # delta: per-writer tail slices — O(|delta|), not O(history)
+            payload = {
+                _wire_key(k[0] + epoch, k[1], k[2]): dict(v)
+                for k, v in self._foreign
+            }
+            for w, lst in self._by_writer.items():
+                if not lst:
+                    continue
+                start = since.get(w, -1) + 1 - lst[0][0][2]
+                for k, v in lst[max(start, 0):]:
+                    payload[_wire_key(k[0] + epoch, k[1], k[2])] = dict(v)
+        if self._needs_sections_locked(since):
+            payload[FRONTIER_KEY] = {
+                str(r): s for r, s in self._frontier.items()
+            }
+            payload[SUMMARY_KEY] = {
+                k: dict(e) for k, e in self._summary.items()
+            }
+        return payload
+
+    def gossip_payload_json(
+        self, since: Optional[Dict[int, int]] = None
+    ) -> Optional[bytes]:
+        """``gossip_payload`` pre-serialized to UTF-8 JSON bytes — the HTTP
+        serving path.  When the native runtime is up and no compaction
+        sections are needed, the bytes are emitted by the C++ wire store
+        (one pass over the op map, zero Python dict/string churn);
+        otherwise json.dumps of the Python payload, under the SAME lock
+        acquisition (one consistent snapshot either way)."""
+        if not self.alive:
+            return None
+        with self._lock:
+            if self._wire is not None and not self._needs_sections_locked(since):
+                return self._wire.payload_json(since)
+            payload = self._payload_locked(since)
+        return json.dumps(payload).encode()
 
     def receive(self, payload: Optional[Dict[str, Any]]) -> int:
         """Pull-side merge of a peer's gossip payload (main.go:250-257);
@@ -443,11 +472,16 @@ class ReplicaNode:
 
     def _prune_commands_locked(self) -> None:
         f = self._frontier
-        self._commands = {
+        kept = {
             k: v
             for k, v in self._commands.items()
             if not (k[1] >= 0 and k[2] <= f.get(k[1], -1))
         }
+        if self._wire is not None:
+            epoch = self.clock.epoch_ms
+            for k in self._commands.keys() - kept.keys():
+                self._wire.remove(k[0] + epoch, k[1], k[2])
+        self._commands = kept
         for w, lst in self._by_writer.items():
             cut = f.get(w, -1)
             if lst and lst[0][0][2] <= cut:
@@ -460,6 +494,13 @@ class ReplicaNode:
         self._foreign = []
         self._vv = {}
         self._summary_cache = None
+        if self._wire is not None:
+            from crdt_tpu import native
+
+            self._wire = native.WireStore(self.keys, self.values)
+            epoch = self.clock.epoch_ms
+            for (ts, rid, seq), cmd in self._commands.items():
+                self._wire.add(ts + epoch, rid, seq, cmd)
         for ident in sorted(self._commands, key=lambda k: (k[1], k[2], k[0])):
             stored = self._commands[ident]
             rid, seq = ident[1], ident[2]
@@ -575,6 +616,8 @@ class ReplicaNode:
                 continue  # already folded into the summary
             stored = dict(cmd)
             self._commands[ident] = stored
+            if self._wire is not None:
+                self._wire.add(ts + self.clock.epoch_ms, rid, seq, stored)
             if rid >= 0:
                 self._by_writer.setdefault(rid, []).append((ident, stored))
                 if seq > self._vv.get(rid, -1):

@@ -6,6 +6,7 @@ from crdt_tpu.models import (  # noqa: F401
     lww,
     mvregister,
     oplog,
+    ormap,
     orset,
     pncounter,
     rseq,

@@ -1,0 +1,119 @@
+"""OR-Map: observed-remove map composing a per-key value lattice.
+
+The reference's whole store IS one map (string key → PN-Counter/LWW cell,
+/root/reference/main.go:25) with no removal; the OR-Map is the general
+composition every CRDT framework ships: a key is *present* under
+observed-remove semantics (a remove only masks updates it has seen — a
+concurrent update keeps the key alive), and each key's value is ANY
+lattice the caller picks (PN-Counter, LWW, OR-Set, …).
+
+Encoding (TPU-first: the map is a product of fixed-shape planes)
+----------------------------------------------------------------
+For a key space of size ``K`` and writer universe ``W``:
+
+* presence = a batched observed-token plane (crdt_tpu.models.flags
+  machinery): ``tok: int32[K, W]``, ``obs: int32[K, W, W]`` — an update
+  drops a token for the key, a remove clears the tokens it has observed;
+  ``contains`` = some token unobserved.  Pure max-lattice → presence joins
+  ride the pmax collective fast path unchanged.
+* values = the caller's value-lattice pytree with leading axis K; the map
+  join is presence-join × value-join (a product lattice, so the CRDT laws
+  are inherited component-wise).
+
+Semantics note (honest difference from Riak-style maps): a removed key's
+value state is NOT reset — reset is not monotone, and the reference never
+prunes state either (its log grows forever, main.go:75).  A re-added key
+therefore surfaces its accumulated value, exactly like a revived reference
+replica re-learns the full history via gossip.  Callers wanting
+reset-on-remove semantics compose per-key versioned values (e.g. an
+LWW-of-snapshots) on top.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from crdt_tpu.models import flags
+
+
+@struct.dataclass
+class ORMap:
+    presence: flags.TokenPlane  # tok[K, W], obs[K, W, W]
+    values: Any                 # value-lattice pytree, leading axis K
+
+    @property
+    def n_keys(self) -> int:
+        return self.presence.tok.shape[-2]
+
+    @property
+    def n_writers(self) -> int:
+        return self.presence.tok.shape[-1]
+
+
+def empty(n_keys: int, n_writers: int, value_zero: Any) -> ORMap:
+    """``value_zero``: ONE value-lattice instance (the join identity);
+    broadcast across the key axis."""
+    values = jax.tree.map(
+        lambda l: jnp.broadcast_to(l[None], (n_keys,) + l.shape), value_zero
+    )
+    return ORMap(
+        presence=flags.plane_zero(n_writers, batch=(n_keys,)), values=values
+    )
+
+
+def update(m: ORMap, key, writer, apply_fn: Callable[[Any], Any]) -> ORMap:
+    """Mutate key ``key``: mark presence (a fresh observed-remove token for
+    ``writer``) and apply ``apply_fn`` to that key's value instance (e.g.
+    ``lambda v: pncounter.add(v, node, 5)``)."""
+    row = jax.tree.map(lambda l: l[key], m.values)
+    new_row = apply_fn(row)
+    # per-key token drop (flags.plane_token's [..., w] form would touch
+    # every key row of the batched plane)
+    presence = m.presence.replace(
+        tok=m.presence.tok.at[key, writer].add(1)
+    )
+    return ORMap(
+        presence=presence,
+        values=jax.tree.map(
+            lambda l, r: l.at[key].set(r), m.values, new_row
+        ),
+    )
+
+
+def remove(m: ORMap, key, writer) -> ORMap:
+    """Observed-remove of ``key``: clears only the presence tokens this
+    state has seen; a concurrent update survives the join (add-wins)."""
+    presence = m.presence.replace(
+        obs=m.presence.obs.at[key, writer, :].set(m.presence.tok[key])
+    )
+    return m.replace(presence=presence)
+
+
+def contains(m: ORMap) -> jax.Array:
+    """bool[K]: which keys are present (some update unobserved by every
+    remove)."""
+    return flags.plane_active(m.presence)
+
+
+def get(m: ORMap, key) -> Any:
+    """The value instance at ``key`` (meaningful when contains(m)[key])."""
+    return jax.tree.map(lambda l: l[key], m.values)
+
+
+def join(a: ORMap, b: ORMap, value_join_batched: Callable) -> ORMap:
+    """Product join: presence max-join × batched value join (the value
+    joiner sees the whole [K, ...] plane — use jax.vmap(join) for
+    single-instance joins)."""
+    return ORMap(
+        presence=flags.plane_join(a.presence, b.presence),
+        values=value_join_batched(a.values, b.values),
+    )
+
+
+def joiner(value_join_batched: Callable) -> Callable:
+    """A two-argument ORMap join closure (for swarm/mesh engines that take
+    ``join(a, b)``)."""
+    return lambda a, b: join(a, b, value_join_batched)

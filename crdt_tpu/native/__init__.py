@@ -23,6 +23,12 @@ _lib: Optional[ctypes.CDLL] = None
 
 def _build() -> bool:
     try:
+        src_mtime = max(
+            (_DIR / "ingest.cpp").stat().st_mtime,
+            (_DIR / "Makefile").stat().st_mtime,
+        )
+        if _SO.exists() and _SO.stat().st_mtime >= src_mtime:
+            return True  # fresh: skip the make fork on every import
         subprocess.run(
             ["make", "-C", str(_DIR), "-s"], check=True, capture_output=True
         )
@@ -33,9 +39,23 @@ def _build() -> bool:
 
 def _load() -> Optional[ctypes.CDLL]:
     global AVAILABLE
-    if not _SO.exists() and not _build():
+    # rebuild when ingest.cpp is newer than a previously-committed .so
+    # (stale-binary hazard); _build stats mtimes and skips the make fork
+    # when fresh
+    _build()
+    if not _SO.exists():
         return None
-    lib = ctypes.CDLL(str(_SO))
+    try:
+        lib = _bind(ctypes.CDLL(str(_SO)))
+    except (OSError, AttributeError):
+        # stale .so missing newer symbols on a machine where make failed:
+        # fall back cleanly to the pure-Python path (module contract)
+        return None
+    AVAILABLE = True
+    return lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.crdt_interner_new.restype = ctypes.c_void_p
     lib.crdt_interner_free.argtypes = [ctypes.c_void_p]
     lib.crdt_intern.restype = ctypes.c_int32
@@ -68,7 +88,26 @@ def _load() -> Optional[ctypes.CDLL]:
         fn.argtypes = [ctypes.c_void_p]
     lib.crdt_batch_is_num.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.crdt_batch_is_num.argtypes = [ctypes.c_void_p]
-    AVAILABLE = True
+    lib.crdt_wire_new.restype = ctypes.c_void_p
+    lib.crdt_wire_free.argtypes = [ctypes.c_void_p]
+    lib.crdt_wire_add.restype = ctypes.c_int32
+    lib.crdt_wire_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.crdt_wire_remove.restype = ctypes.c_int32
+    lib.crdt_wire_remove.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32
+    ]
+    lib.crdt_wire_size.restype = ctypes.c_int32
+    lib.crdt_wire_size.argtypes = [ctypes.c_void_p]
+    lib.crdt_wire_payload.restype = ctypes.POINTER(ctypes.c_char)
+    lib.crdt_wire_payload.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+    ]
     return lib
 
 
@@ -150,3 +189,52 @@ class OpBatchPacker:
         cols["is_num"] = np.ctypeslib.as_array(p, shape=(n,)).astype(bool)
         _lib.crdt_batch_clear(self._h)
         return cols
+
+
+class WireStore:
+    """Native mirror of a node's op->command map with a direct-to-JSON
+    gossip payload emitter (the serving hot path: the reference marshals
+    its whole treemap per /gossip request, main.go:159; here the bytes are
+    built in C++ straight from the interner arenas)."""
+
+    def __init__(self, keys: NativeInterner, vals: NativeInterner):
+        assert _lib is not None, "native runtime unavailable"
+        self.keys, self.vals = keys, vals
+        self._h = _lib.crdt_wire_new()
+
+    def __del__(self):
+        if _lib is not None and getattr(self, "_h", None):
+            _lib.crdt_wire_free(self._h)
+            self._h = None
+
+    def add(self, ts_abs: int, rid: int, seq: int, cmd: dict) -> bool:
+        n = len(cmd)
+        kids = (ctypes.c_int32 * n)(
+            *(self.keys.intern(k) for k in cmd)
+        )
+        vids = (ctypes.c_int32 * n)(
+            *(self.vals.intern(v) for v in cmd.values())
+        )
+        return bool(_lib.crdt_wire_add(self._h, ts_abs, rid, seq, n, kids, vids))
+
+    def remove(self, ts_abs: int, rid: int, seq: int) -> bool:
+        return bool(_lib.crdt_wire_remove(self._h, ts_abs, rid, seq))
+
+    def __len__(self) -> int:
+        return _lib.crdt_wire_size(self._h)
+
+    def payload_json(self, since: "dict | None") -> bytes:
+        """The gossip payload as UTF-8 JSON bytes; ``since`` = requester's
+        version vector for delta emission (None = full dump)."""
+        n_vv = len(since) if since else 0
+        rids = (ctypes.c_int32 * max(n_vv, 1))(*(since or {0: 0}))
+        seqs = (ctypes.c_int32 * max(n_vv, 1))(
+            *((since or {0: 0}).values())
+        )
+        out_len = ctypes.c_int32()
+        p = _lib.crdt_wire_payload(
+            self._h, self.keys._h, self.vals._h,
+            1 if since is not None else 0, rids, seqs, n_vv,
+            ctypes.byref(out_len),
+        )
+        return ctypes.string_at(p, out_len.value)

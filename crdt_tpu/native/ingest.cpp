@@ -14,8 +14,12 @@
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this image).
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
+#include <map>
 #include <string>
+#include <tuple>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -129,6 +133,40 @@ struct OpBatch {
   std::vector<uint8_t> is_num;
 };
 
+// Gossip wire store: the op->command map mirrored in native memory, with a
+// direct-to-JSON payload emitter (the gossip SERVING hot path — the
+// reference marshals its whole treemap per request, main.go:159).  Keys
+// are (absolute-ms ts, rid, seq); values are interner-id pairs so the
+// emitter pulls raw strings straight from the interner arenas.
+struct WireStore {
+  using Ident = std::tuple<int64_t, int32_t, int32_t>;
+  std::map<Ident, std::vector<std::pair<int32_t, int32_t>>> ops;  // sorted
+  std::string buf;  // last emitted payload (stable until the next emit)
+};
+
+void json_escape_append(std::string& out, const char* s, int32_t len) {
+  for (int32_t i = 0; i < len; ++i) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char tmp[8];
+          std::snprintf(tmp, sizeof tmp, "\\u%04x", c);
+          out += tmp;
+        } else {
+          out += static_cast<char>(c);  // UTF-8 passes through byte-wise
+        }
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -196,5 +234,83 @@ int32_t* crdt_batch_key(void* p) { return static_cast<OpBatch*>(p)->key.data(); 
 int32_t* crdt_batch_val(void* p) { return static_cast<OpBatch*>(p)->val.data(); }
 int32_t* crdt_batch_payload(void* p) { return static_cast<OpBatch*>(p)->payload.data(); }
 uint8_t* crdt_batch_is_num(void* p) { return static_cast<OpBatch*>(p)->is_num.data(); }
+
+// ---- wire store ----
+
+void* crdt_wire_new() { return new WireStore(); }
+void crdt_wire_free(void* p) { delete static_cast<WireStore*>(p); }
+
+// Add one command's (key_id, val_id) pairs under identity (ts, rid, seq).
+// Returns 1 if the identity was fresh, 0 for a duplicate (union no-op).
+int32_t crdt_wire_add(void* p, int64_t ts_abs, int32_t rid, int32_t seq,
+                      int32_t n, const int32_t* key_ids,
+                      const int32_t* val_ids) {
+  WireStore* w = static_cast<WireStore*>(p);
+  auto [it, fresh] = w->ops.try_emplace({ts_abs, rid, seq});
+  if (!fresh) return 0;
+  it->second.reserve(n);
+  for (int32_t i = 0; i < n; ++i) {
+    it->second.emplace_back(key_ids[i], val_ids[i]);
+  }
+  return 1;
+}
+
+int32_t crdt_wire_remove(void* p, int64_t ts_abs, int32_t rid, int32_t seq) {
+  return static_cast<WireStore*>(p)->ops.erase({ts_abs, rid, seq}) ? 1 : 0;
+}
+
+int32_t crdt_wire_size(void* p) {
+  return static_cast<int32_t>(static_cast<WireStore*>(p)->ops.size());
+}
+
+// Emit the gossip payload JSON: {"ts:rid:seq": {"key": "value", ...}, ...}
+// in identity order.  With have_vv, ops covered by the requester's version
+// vector (rid >= 0 and seq <= vv[rid]) are skipped — delta gossip; rid < 0
+// (foreign/Go-format) ops are always shipped, like the Python path.
+// The returned pointer is owned by the store, valid until the next emit.
+const char* crdt_wire_payload(void* p, void* keys_interner,
+                              void* vals_interner, int32_t have_vv,
+                              const int32_t* vv_rids, const int32_t* vv_seqs,
+                              int32_t n_vv, int32_t* len_out) {
+  WireStore* w = static_cast<WireStore*>(p);
+  Interner* ki = static_cast<Interner*>(keys_interner);
+  Interner* vi = static_cast<Interner*>(vals_interner);
+  std::unordered_map<int32_t, int32_t> vv;
+  for (int32_t i = 0; i < n_vv; ++i) vv[vv_rids[i]] = vv_seqs[i];
+
+  std::string& out = w->buf;
+  out.clear();
+  out += '{';
+  bool first = true;
+  char ident[64];
+  for (const auto& [id, kvs] : w->ops) {
+    const auto& [ts, rid, seq] = id;
+    if (have_vv && rid >= 0) {
+      auto it = vv.find(rid);
+      if (it != vv.end() && seq <= it->second) continue;  // covered
+    }
+    if (!first) out += ',';
+    first = false;
+    std::snprintf(ident, sizeof ident, "\"%lld:%d:%d\":{",
+                  static_cast<long long>(ts), rid, seq);
+    out += ident;
+    bool kfirst = true;
+    for (const auto& [kid, vid] : kvs) {
+      if (!kfirst) out += ',';
+      kfirst = false;
+      out += '"';
+      json_escape_append(out, ki->arena.data.data() + ki->arena.offsets[kid],
+                         static_cast<int32_t>(ki->arena.lengths[kid]));
+      out += "\":\"";
+      json_escape_append(out, vi->arena.data.data() + vi->arena.offsets[vid],
+                         static_cast<int32_t>(vi->arena.lengths[vid]));
+      out += '"';
+    }
+    out += '}';
+  }
+  out += '}';
+  *len_out = static_cast<int32_t>(out.size());
+  return out.data();
+}
 
 }  // extern "C"

@@ -1,0 +1,54 @@
+"""Multi-host helper tests (single-process degradation on the 8-device
+virtual CPU mesh; real DCN spans are exercised by the same code because
+mesh.py's collectives are ordinary XLA collectives)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crdt_tpu.models import gcounter, oplog
+from crdt_tpu.parallel import mesh as mesh_lib, multihost, swarm
+
+
+def test_init_noop_without_cluster_env(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert multihost.init_from_env() is False
+
+
+def test_global_mesh_covers_all_devices():
+    m = multihost.global_mesh()
+    assert m.devices.size == len(jax.devices()) == 8
+
+
+def test_shard_host_local_and_converge():
+    m = multihost.global_mesh()
+    r = 16
+    state = gcounter.GCounter(
+        counts=np.arange(r * 4, dtype=np.int32).reshape(r, 4)
+    )
+    sharded = multihost.shard_host_local(state, m)
+    assert sharded.counts.shape == (r, 4)
+    s = swarm.make(sharded)
+    step = mesh_lib.pmax_converge(m)
+    out = step(s)
+    want = np.asarray(state.counts).max(axis=0)
+    got = np.asarray(out.state.counts)
+    assert (got == want[None, :]).all()
+
+
+def test_shard_host_local_generic_lattice():
+    m = multihost.global_mesh()
+    logs = [oplog.empty(32) for _ in range(8)]
+    state = jax.tree.map(lambda *xs: jnp.stack(xs), *logs)
+    sharded = multihost.shard_host_local(state, m)
+    s = swarm.make(sharded)
+    step = mesh_lib.sharded_converge(
+        m, join_batched=jax.vmap(oplog.merge), join_single=oplog.merge,
+        neutral=oplog.empty(32),
+    )
+    out = step(s)
+    assert int(jax.vmap(oplog.size)(out.state).sum()) == 0
+
+
+def test_process_span_single():
+    assert multihost.process_span() == (0, 1)

@@ -1,0 +1,114 @@
+"""OR-Map tests (crdt_tpu.models.ormap): observed-remove key semantics
+composed with PN-Counter and LWW value lattices, join laws on reachable
+states, swarm integration."""
+import zlib
+
+import jax
+import numpy as np
+import pytest
+
+from crdt_tpu.models import lww, ormap, pncounter
+from tests.helpers import tree_equal
+
+K, W, NODES = 6, 4, 4
+N_TRIALS = 15
+
+pn_join = ormap.joiner(pncounter.join)
+
+
+def _empty():
+    return ormap.empty(K, W, pncounter.zero(NODES))
+
+
+_next_writer = iter(range(10_000))
+
+
+def _rand_map(rng: np.random.Generator) -> ormap.ORMap:
+    m = _empty()
+    w = next(_next_writer) % W  # one writer per generated state, unique mod W
+    for _ in range(rng.integers(0, 8)):
+        k = int(rng.integers(0, K))
+        if rng.random() < 0.25:
+            m = ormap.remove(m, k, w)
+        else:
+            delta = int(rng.integers(-10, 10))
+            m = ormap.update(
+                m, k, w, lambda v: pncounter.add(v, w % NODES, delta)
+            )
+    return m
+
+
+def test_join_laws():
+    rng = np.random.default_rng(zlib.crc32(b"ormap"))
+    for _ in range(N_TRIALS):
+        a, b, c = _rand_map(rng), _rand_map(rng), _rand_map(rng)
+        assert tree_equal(pn_join(a, b), pn_join(b, a)), "commutativity"
+        assert tree_equal(
+            pn_join(pn_join(a, b), c), pn_join(a, pn_join(b, c))
+        ), "associativity"
+        assert tree_equal(pn_join(a, a), a), "idempotence"
+        assert tree_equal(pn_join(a, _empty()), a), "identity"
+
+
+def test_update_then_read():
+    m = _empty()
+    m = ormap.update(m, 2, 0, lambda v: pncounter.add(v, 0, 5))
+    m = ormap.update(m, 2, 1, lambda v: pncounter.add(v, 1, -3))
+    present = np.asarray(ormap.contains(m))
+    assert present[2] and not present[0]
+    assert int(pncounter.value(ormap.get(m, 2))) == 2
+
+
+def test_observed_remove_add_wins():
+    """A remove masks only what it saw: concurrent update keeps the key."""
+    base = ormap.update(_empty(), 1, 0, lambda v: pncounter.add(v, 0, 7))
+    a = ormap.remove(base, 1, 1)                   # saw the update, removes
+    b = ormap.update(base, 1, 2,
+                     lambda v: pncounter.add(v, 2, 1))  # concurrent update
+    m = pn_join(a, b)
+    assert bool(ormap.contains(m)[1])              # add-wins
+    assert int(pncounter.value(ormap.get(m, 1))) == 8
+    # sequential remove AFTER seeing everything does hide the key
+    m2 = ormap.remove(m, 1, 1)
+    assert not bool(ormap.contains(m2)[1])
+
+
+def test_removed_key_value_accumulates():
+    """Documented semantics: value state survives removal (monotone); a
+    re-add surfaces the accumulated value."""
+    m = ormap.update(_empty(), 3, 0, lambda v: pncounter.add(v, 0, 10))
+    m = ormap.remove(m, 3, 0)
+    assert not bool(ormap.contains(m)[3])
+    m = ormap.update(m, 3, 0, lambda v: pncounter.add(v, 0, 1))
+    assert bool(ormap.contains(m)[3])
+    assert int(pncounter.value(ormap.get(m, 3))) == 11
+
+
+def test_lww_valued_map():
+    lw_join = ormap.joiner(lww.join)
+    m = ormap.empty(K, W, lww.zero())
+    m = ormap.update(m, 0, 1, lambda v: lww.write(v, ts=10, rid=1, payload=111))
+    n = ormap.empty(K, W, lww.zero())
+    n = ormap.update(n, 0, 2, lambda v: lww.write(v, ts=11, rid=2, payload=222))
+    j = lw_join(m, n)
+    assert int(ormap.get(j, 0).payload) == 222  # newest-timestamp wins
+    assert bool(ormap.contains(j)[0])
+
+
+def test_swarm_converge():
+    from crdt_tpu.parallel import swarm
+
+    R = 4
+    rows = []
+    for r in range(R):
+        m = _empty()
+        if r == 2:
+            m = ormap.update(m, 0, r, lambda v: pncounter.add(v, r, r + 1))
+        rows.append(m)
+    state = jax.tree.map(lambda *xs: jax.numpy.stack(xs), *rows)
+    s = swarm.make(state)
+    s = swarm.converge(s, jax.vmap(pn_join), _empty())
+    for i in range(R):
+        row = jax.tree.map(lambda x: x[i], s.state)
+        assert bool(ormap.contains(row)[0])
+        assert int(pncounter.value(ormap.get(row, 0))) == 3
