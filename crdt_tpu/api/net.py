@@ -70,10 +70,29 @@ class RemotePeer:
         """GET /ping (main.go:115-127)."""
         return self._get("/ping") is not None
 
+    @staticmethod
+    def _parse(body: Optional[bytes]):
+        """Decode a peer response; a peer serving corrupt bytes is treated
+        exactly like an unreachable one (skip this round, try again later)
+        — one bad peer must not kill the pull loop, which is the loud-but-
+        total failure mode the reference had (quirk §0.1.8).  Malformed
+        *content* inside valid JSON (bad wire keys) still raises in
+        ReplicaNode.receive."""
+        if body is None:
+            return None
+        try:
+            parsed = json.loads(body)
+        except ValueError:
+            return None
+        # every endpoint we consume returns a JSON OBJECT; a 200 carrying
+        # '"Service Unavailable"', 'null', '[]', ... (a proxy in front of a
+        # dead peer) is structurally corrupt and must hit the same skip
+        # path — not reach node.receive and kill the loop
+        return parsed if isinstance(parsed, dict) else None
+
     def get_state(self) -> Optional[Dict[str, str]]:
         """GET /data (main.go:129-139); None when down/unreachable."""
-        body = self._get("/data")
-        return None if body is None else json.loads(body)
+        return self._parse(self._get("/data"))
 
     def gossip_payload(
         self, since: Optional[Dict[int, int]] = None
@@ -84,8 +103,7 @@ class RemotePeer:
         if since is not None:
             vv = json.dumps({str(r): s for r, s in since.items()})
             path += "?vv=" + urllib.parse.quote(vv)
-        body = self._get(path)
-        return None if body is None else json.loads(body)
+        return self._parse(self._get(path))
 
     def add_command(self, cmd: Dict[str, str]) -> bool:
         """POST /data (main.go:173-215)."""
@@ -98,10 +116,9 @@ class RemotePeer:
     def version_vector(self):
         """GET /vv → ({rid: seq} received watermark, {rid: seq} folded
         frontier), or None when down/unreachable."""
-        body = self._get("/vv")
-        if body is None:
+        d = self._parse(self._get("/vv"))
+        if d is None:
             return None
-        d = json.loads(body)
         return (
             {int(r): int(s) for r, s in (d.get("vv") or {}).items()},
             {int(r): int(s) for r, s in (d.get("frontier") or {}).items()},

@@ -1,0 +1,176 @@
+"""Quirk-compat HTTP surface: the reference's observable behavior served
+bit-for-bit over its five routes, backed by the quirks-ON oracle.
+
+The Go toolchain is absent in this image, so black-box parity runs against
+THIS server instead of the original: it reproduces, over real HTTP,
+exactly what `go run main.go` serves — including the bugs
+(SURVEY.md §0.1): ts-only log keys, the broken `/condition` route (always
+500, §0.1.7), multi-key early return (§0.1.4), local-op exclusion after a
+merge (§0.1.1), and the two-pointer tail-drop (§0.1.3).  The fixed
+framework surface lives in crdt_tpu.api.http_shim; tests drive both and
+assert where they must agree (converged numerics) and where the quirk
+surface must FAITHFULLY disagree (the bugs).
+
+Wire format: the reference's `Gossip` marshals its treemap as
+{"<unix-ms>": {key: value}, ...} (main.go:159); with the ts_only_keys
+quirk the oracle's log keys are 1-tuples, serialized here as the bare
+millisecond string — byte-compatible with the Go server's JSON.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from crdt_tpu.oracle.replica import OracleReplica, Quirks
+from crdt_tpu.utils.clock import HostClock
+
+
+class OracleNode:
+    """One quirks-ON oracle replica + the host plumbing the shim needs."""
+
+    def __init__(self, rid: int, clock: Optional[HostClock] = None):
+        self.oracle = OracleReplica(rid=rid, quirks=Quirks.reference())
+        self.clock = clock or HostClock()
+        self._lock = threading.Lock()  # the reference's Server.Lock
+
+    @property
+    def alive(self) -> bool:
+        return self.oracle.alive
+
+    def add_command(self, cmd) -> bool:
+        with self._lock:
+            if not self.oracle.alive:
+                return False
+            self.oracle.add_command(dict(cmd), ts=self.clock.now_ms())
+            return True
+
+    def get_state(self):
+        # GetState reads CurrentState without the lock (quirk §0.1.6);
+        # faithfully lock-free here
+        if not self.oracle.alive:
+            return None
+        return dict(self.oracle.state)
+
+    def gossip_wire(self) -> Optional[str]:
+        with self._lock:  # Gossip takes the lock (main.go:156)
+            if not self.oracle.alive:
+                return None
+            return json.dumps(
+                # log entries are (command, is_local): the pointer/value
+                # distinction does not survive serialization (main.go:159),
+                # which is exactly what makes quirk 0.1.1 asymmetric
+                {str(k[0]): dict(entry[0])
+                 for k, entry in sorted(self.oracle.log.items())}
+            )
+
+    def receive_wire(self, body: str) -> None:
+        """The gossip goroutine's unmarshal + merge (main.go:241-257)."""
+        remote = {
+            (int(ts),): dict(cmd) for ts, cmd in json.loads(body).items()
+        }
+        with self._lock:
+            self.oracle.merge(remote)
+
+
+def _make_handler(node: OracleNode):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code, body):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path == "/ping":
+                if node.alive:
+                    self._send(200, "Pong")
+                else:
+                    self._send(502, "Unreachable")  # main.go:119-126
+            elif path == "/data":
+                state = node.get_state()
+                if state is None:
+                    self._send(502, "Unreachable")
+                else:
+                    self._send(200, json.dumps(state))
+            elif path == "/gossip":
+                wire = node.gossip_wire()
+                if wire is None:
+                    self._send(502, "Unreachable")
+                else:
+                    self._send(200, wire)
+            elif path == "/condition":
+                # the reference registered the route WITHOUT the parameter
+                # binding, so ParseBool("") always errors -> 500 (§0.1.7);
+                # byte-faithful breakage
+                self._send(500, "Unable to process request")
+            else:
+                self._send(404, "404 page not found")
+
+        def do_POST(self):
+            if self.path.split("?")[0] != "/data":
+                self._send(404, "404 page not found")
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                cmd = json.loads(self.rfile.read(n) or b"")
+                assert isinstance(cmd, dict)
+                cmd = {str(k): str(v) for k, v in cmd.items()}
+            except Exception:
+                self._send(500, "Request body is invalid")
+                return
+            if node.add_command(cmd):
+                self._send(200, "Inserted")
+            else:
+                self._send(502, "Unreachable")
+
+    return Handler
+
+
+class OracleHttpCluster:
+    """N quirks-ON replicas served on real sockets + a manual gossip
+    driver (pull `idx` from `peer` — the goroutine at main.go:226-261,
+    driven deterministically for tests)."""
+
+    def __init__(self, n: int = 2, clock: Optional[HostClock] = None):
+        clock = clock or HostClock()
+        self.nodes: List[OracleNode] = [
+            OracleNode(rid=i, clock=clock) for i in range(n)
+        ]
+        self.servers: List[ThreadingHTTPServer] = []
+        self.urls: List[str] = []
+
+    def start(self) -> List[str]:
+        for node in self.nodes:
+            srv = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler(node))
+            self.servers.append(srv)
+            self.urls.append(f"http://127.0.0.1:{srv.server_address[1]}")
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return self.urls
+
+    def stop(self) -> None:
+        for srv in self.servers:
+            srv.shutdown()
+            srv.server_close()
+        self.servers.clear()
+
+    def gossip_once(self, idx: int, peer: int) -> bool:
+        """node idx pulls peer's full log over HTTP and merges."""
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                self.urls[peer] + "/gossip", timeout=5
+            ) as res:
+                if res.status != 200:
+                    return False
+                self.nodes[idx].receive_wire(res.read().decode())
+                return True
+        except Exception:
+            return False  # dead peer skipped (main.go:235-239)

@@ -1,0 +1,153 @@
+"""Black-box parity against the reference's OBSERVABLE behavior, over real
+HTTP: the quirks-ON oracle server (crdt_tpu.oracle.shim) must reproduce
+the Go server's responses bug-for-bug, and the fixed framework surface
+must differ exactly where the fixes are documented (SURVEY.md §0.1)."""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from crdt_tpu.oracle.shim import OracleHttpCluster
+from crdt_tpu.utils.clock import ManualClock
+
+
+def _req(url, method="GET", data=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as res:
+            return res.status, res.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.fixture
+def quirky():
+    c = OracleHttpCluster(n=2, clock=ManualClock(start=1_000_000))
+    c.start()
+    yield c
+    c.stop()
+
+
+def _tick(c):
+    c.nodes[0].clock.advance(10)
+
+
+def test_surface_matches_reference(quirky):
+    u = quirky.urls[0]
+    assert _req(u + "/ping") == (200, b"Pong")
+    assert _req(u + "/data")[1] == b"{}"
+    # the broken /condition route: ALWAYS 500, exactly like the Go server
+    # whose route lacks the :alive_status binding (quirk 0.1.7)
+    assert _req(u + "/condition")[0] == 500
+    assert _req(u + "/condition?alive_status=false")[0] == 500
+    assert _req(u + "/nope")[0] == 404
+    code, body = _req(u + "/data", "POST", b"not json")
+    assert (code, body) == (500, b"Request body is invalid")  # main.go:179-186
+    code, body = _req(u + "/data", "POST", json.dumps({"x": "5"}).encode())
+    assert (code, body) == (200, b"Inserted")  # main.go:208
+
+
+def test_multikey_early_return_over_http(quirky):
+    """Quirk 0.1.4: a multi-key command stops applying to CurrentState at
+    the first previously-unseen key; the LOG still holds every key, so a
+    merge-time rebuild surfaces them all."""
+    u = quirky.urls[0]
+    _req(u + "/data", "POST", json.dumps({"a": "1", "b": "2"}).encode())
+    state = json.loads(_req(u + "/data")[1])
+    assert state == {"a": "1"}  # b vanished from the eager fold
+    # but the wire carries the whole command
+    wire = json.loads(_req(u + "/gossip")[1])
+    assert list(wire.values()) == [{"a": "1", "b": "2"}]
+    # peer adopts it (own newer entry first — tail-drop, 0.1.3) and the
+    # merge-time rebuild surfaces BOTH keys (its own entry is excluded,
+    # 0.1.1)
+    _tick(quirky)
+    _req(quirky.urls[1] + "/data", "POST", json.dumps({"z": "9"}).encode())
+    _tick(quirky)
+    assert quirky.gossip_once(1, 0)
+    assert json.loads(_req(quirky.urls[1] + "/data")[1]) == {"a": "1", "b": "2"}
+
+
+def test_tail_drop_empty_replica_adopts_nothing(quirky):
+    """Quirk 0.1.3 at its extreme: the two-pointer union stops at the
+    shorter log, so a replica with an EMPTY log adopts zero entries from a
+    pull — faithful to main.go:49 (self-healing only because replicas keep
+    writing and gossip repeats)."""
+    u0, u1 = quirky.urls
+    _req(u0 + "/data", "POST", json.dumps({"x": "5"}).encode())
+    _tick(quirky)
+    assert quirky.gossip_once(1, 0)
+    assert json.loads(_req(u1 + "/data")[1]) == {}  # nothing adopted!
+
+
+def test_local_op_exclusion_over_http(quirky):
+    """Quirk 0.1.1: after its first merge, a replica's OWN writes no longer
+    count toward its local state (the failed type assertion), while peers
+    keep counting them — plus the tail-drop (0.1.3) hiding the remote's
+    newest entry."""
+    u0, u1 = quirky.urls
+    _req(u0 + "/data", "POST", json.dumps({"x": "5"}).encode())  # t1 @ node0
+    _tick(quirky)
+    _req(u1 + "/data", "POST", json.dumps({"z": "9"}).encode())  # t2 @ node1
+    _tick(quirky)
+    assert quirky.gossip_once(1, 0)  # node1 adopts t1 (older than its t2)
+    # node1's rebuild: its OWN t2 is excluded (pointer entry), adopted t1
+    # counts — so x survives and node1's own z vanishes locally
+    assert json.loads(_req(u1 + "/data")[1]) == {"x": "5"}
+    assert json.loads(_req(u0 + "/data")[1]) == {"x": "5"}  # pre-merge: eager
+    assert quirky.gossip_once(0, 1)
+    # node0's merge: equal-t1 keys -> local pointer retained; t2 is beyond
+    # node0's newest local entry -> tail-dropped; rebuild excludes its own
+    # t1 -> node0 reads EMPTY while node1 still reads x=5
+    assert json.loads(_req(u0 + "/data")[1]) == {}
+    assert json.loads(_req(u1 + "/data")[1]) == {"x": "5"}
+    # the fixed framework keeps counting everything (the documented fix)
+    from crdt_tpu.api.net import NodeHost, RemotePeer
+
+    a = NodeHost(rid=0, peers=[])
+    b = NodeHost(rid=1, peers=[])
+    import threading
+
+    for h in (a, b):
+        threading.Thread(target=h._server.serve_forever, daemon=True).start()
+    try:
+        a.agent.peers = [RemotePeer(b.url)]
+        b.agent.peers = [RemotePeer(a.url)]
+        RemotePeer(a.url).add_command({"x": "5"})
+        b.agent.gossip_once()
+        a.agent.gossip_once()
+        assert RemotePeer(a.url).get_state() == {"x": "5"}  # fix holds
+    finally:
+        for h in (a, b):
+            h._server.shutdown()
+            h._server.server_close()
+
+
+def test_same_ms_overwrite_over_http(quirky):
+    """Quirk 0.1.2: the log key is the bare millisecond; a second write in
+    the same ms replaces the first in the log."""
+    u = quirky.urls[0]
+    _req(u + "/data", "POST", json.dumps({"x": "1"}).encode())
+    _req(u + "/data", "POST", json.dumps({"y": "2"}).encode())  # same ms
+    wire = json.loads(_req(u + "/gossip")[1])
+    assert len(wire) == 1 and list(wire.values()) == [{"y": "2"}]
+
+
+def test_numeric_convergence_where_no_quirk_fires(quirky):
+    """Distinct-ms single-writer traffic adopted by a peer converges to the
+    same sums the fixed framework produces — the capability under the
+    bugs is intact, which is what 'parity' means here."""
+    u0, u1 = quirky.urls
+    for delta in ("-11", "-20", "5"):
+        _req(u0 + "/data", "POST", json.dumps({"k": delta}).encode())
+        _tick(quirky)
+    # node1 needs a NEWER local entry for the two-pointer walk to adopt
+    # the remote ops (quirk 0.1.3); its own entry is then excluded from
+    # its rebuild (quirk 0.1.1), leaving exactly the adopted sum
+    _req(u1 + "/data", "POST", json.dumps({"z": "1"}).encode())
+    _tick(quirky)
+    assert quirky.gossip_once(1, 0)
+    assert json.loads(_req(u1 + "/data")[1]) == {"k": "-26"}

@@ -1,0 +1,154 @@
+"""HTTP-surface robustness: malformed requests and hostile peers must
+produce error codes / skipped rounds — never kill a server thread, a pull
+loop, or node state.  (The reference dies permanently on one malformed
+gossip key, quirk §0.1.8, and 500s-then-continues on bad bodies,
+§0.1.11.)"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from crdt_tpu.api.cluster import LocalCluster
+from crdt_tpu.api.http_shim import HttpCluster
+from crdt_tpu.api.net import NetworkAgent, RemotePeer
+from crdt_tpu.utils.config import ClusterConfig
+
+
+@pytest.fixture
+def served():
+    cluster = LocalCluster(ClusterConfig(n_replicas=2))
+    http = HttpCluster(cluster)
+    ports = http.start()
+    yield cluster, [f"http://127.0.0.1:{p}" for p in ports]
+    http.stop()
+
+
+def _req(url, method="GET", data=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as res:
+            return res.status, res.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.mark.parametrize("body", [
+    b"not json at all",
+    b"[1, 2, 3]",
+    b'"just a string"',
+    b"{",
+    b"{\x00}",
+])
+def test_bad_post_data_bodies(served, body):
+    cluster, urls = served
+    code, text = _req(urls[0] + "/data", "POST", body)
+    assert code == 500 and b"invalid" in text  # main.go:179-186
+    # server healthy afterwards
+    assert _req(urls[0] + "/ping")[0] == 200
+    assert cluster.nodes[0].get_state() == {}
+
+
+def test_bad_vv_query(served):
+    _, urls = served
+    assert _req(urls[0] + "/gossip?vv=garbage")[0] == 400
+    assert _req(urls[0] + "/gossip?vv=%5B1%5D")[0] == 400
+    assert _req(urls[0] + "/gossip")[0] == 200
+
+
+def test_bad_compact_bodies(served):
+    cluster, urls = served
+    for body in (b"nope", b'{"frontier": "x"}', b'{"frontier": {"a": "b"}}'):
+        assert _req(urls[0] + "/compact", "POST", body)[0] == 400
+    assert cluster.nodes[0].frontier == {}
+
+
+def test_unknown_paths_and_conditions(served):
+    _, urls = served
+    assert _req(urls[0] + "/nope")[0] == 404
+    assert _req(urls[0] + "/data/extra")[0] == 404
+    assert _req(urls[0] + "/condition/banana")[0] == 500  # main.go:146-149
+    assert _req(urls[0] + "/condition")[0] == 500
+    assert _req(urls[0] + "/ping")[0] == 200
+
+
+def test_nested_json_values_coerced(served):
+    cluster, urls = served
+    code, _ = _req(urls[0] + "/data", "POST",
+                   json.dumps({"k": {"nested": 1}}).encode())
+    assert code == 200  # values are stringified, like Go's map[string]string-ish
+    state = cluster.nodes[0].get_state()
+    assert "k" in state
+
+
+class _GarbageHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = b"\xff\xfe NOT JSON {{{"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_corrupt_peer_is_skipped_not_fatal():
+    """A peer serving 200 + garbage bytes == unreachable: the pull round is
+    skipped, the agent loop survives, and a later good peer still works."""
+    from crdt_tpu.api.node import ReplicaNode
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _GarbageHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    bad_url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        node = ReplicaNode(rid=0)
+        agent = NetworkAgent(node, [bad_url], ClusterConfig())
+        assert agent.gossip_once() is False  # skip, no exception
+        assert RemotePeer(bad_url).get_state() is None
+        assert RemotePeer(bad_url).version_vector() is None
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_malformed_wire_key_still_raises():
+    """Inside VALID JSON, a malformed op key is a protocol violation and
+    fails loudly (the fix for quirk §0.1.8's silent loop death)."""
+    from crdt_tpu.api.node import ReplicaNode
+
+    node = ReplicaNode(rid=0)
+    with pytest.raises(ValueError):
+        node.receive({"not-a-wire-key": {"x": "1"}})
+
+
+@pytest.mark.parametrize("body", [b'"Service Unavailable"', b"null", b"[]", b"17"])
+def test_valid_json_non_dict_peer_is_skipped(body):
+    """A 200 with valid-JSON-but-not-an-object body (e.g. a proxy fronting
+    a dead peer) must hit the same skip path as corrupt bytes."""
+    from crdt_tpu.api.node import ReplicaNode
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        agent = NetworkAgent(ReplicaNode(rid=0), [url], ClusterConfig())
+        assert agent.gossip_once() is False
+        assert RemotePeer(url).gossip_payload() is None
+    finally:
+        srv.shutdown()
+        srv.server_close()
