@@ -299,19 +299,27 @@ class NodeHost:
         self.url = f"http://{host}:{self.port}"
         self._server_thread: Optional[threading.Thread] = None
 
-    def start(self) -> None:
+    def start_server(self) -> None:
+        """Serve the HTTP surface only (no background gossip) — for drivers
+        that pull deterministically (tests, the network soak)."""
         self._server_thread = threading.Thread(
             target=self._server.serve_forever, daemon=True
         )
         self._server_thread.start()
+
+    def stop_server(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._server_thread is not None:
+            self._server_thread.join(timeout=5)
+            self._server_thread = None
+
+    def start(self) -> None:
+        self.start_server()
         self.agent.start()
 
     def stop(self) -> None:
         try:
             self.agent.stop()
         finally:
-            self._server.shutdown()
-            self._server.server_close()
-            if self._server_thread is not None:
-                self._server_thread.join(timeout=5)
-                self._server_thread = None
+            self.stop_server()

@@ -209,6 +209,127 @@ class SoakRunner:
         return self.heal_and_check()
 
 
+class NetworkSoakRunner:
+    """The soak at the NETWORK level: N served NodeHosts (real sockets,
+    delta gossip over the reference wire, coordinator-scheduled barriers)
+    under the same randomized fault schedule and invariants as SoakRunner.
+
+    Gossip is driven manually (agent.gossip_once) for determinism; the
+    fault model is /condition-style alive toggling, so 'down' daemons
+    refuse service while their server keeps listening — exactly the
+    reference's failure mode (its process never dies either).
+    """
+
+    def __init__(
+        self,
+        n: int = 3,
+        seed: int = 0,
+        p_write: float = 0.4,
+        p_gossip: float = 0.35,
+        p_kill: float = 0.06,
+        p_revive: float = 0.09,
+        p_compact: float = 0.1,
+        n_keys: int = 6,
+    ):
+        from crdt_tpu.api.net import NodeHost, RemotePeer
+
+        self.rng = random.Random(seed)
+        self.hosts = [NodeHost(rid=r, peers=[]) for r in range(n)]
+        for h in self.hosts:
+            h.agent.peers = [
+                RemotePeer(o.url) for o in self.hosts if o is not h
+            ]
+            h.start_server()  # serve only: gossip is driven by step()
+        self.clients = [RemotePeer(h.url) for h in self.hosts]
+        self.oracles = [OracleReplica(rid=r) for r in range(n)]
+        self.p = (p_write, p_gossip, p_kill, p_revive, p_compact)
+        self.keys = [f"k{i}" for i in range(n_keys)]
+        self.report = SoakReport(
+            steps=0, writes_offered=0, writes_accepted=0,
+            writes_rejected_dead=0, gossip_rounds=0, kills=0, revivals=0,
+            barriers=0, barriers_skipped=0, rounds_to_converge=-1,
+            final_state={},
+        )
+
+    def close(self) -> None:
+        for h in self.hosts:
+            h.stop_server()
+
+    def step(self) -> None:
+        r = self.report
+        p_write, p_gossip, p_kill, p_revive, p_compact = self.p
+        x = self.rng.random()
+        i = self.rng.randrange(len(self.hosts))
+        if x < p_write:
+            # numeric-only values: each daemon clock has its own epoch, so
+            # cross-writer ts ordering in the oracle mirror is not
+            # meaningful — sums are order-free, LWW strings would not be
+            cmd = {self.rng.choice(self.keys): str(self.rng.randint(-20, 20))}
+            r.writes_offered += 1
+            # write OVER HTTP; mirror into the oracle with the node's
+            # actual identity (ts assigned server-side, so read it back)
+            if self.clients[i].add_command(cmd):
+                r.writes_accepted += 1
+                node = self.hosts[i].node
+                # latest own-write identity in O(1): the per-writer index
+                # is ascending-seq (crdt_tpu.api.node)
+                ident = node._by_writer[node.rid][-1][0]
+                self.oracles[i].add_command(cmd, ts=ident[0])
+            else:
+                assert not self.hosts[i].node.alive, "alive daemon refused"
+                r.writes_rejected_dead += 1
+        elif x < p_write + p_gossip:
+            r.gossip_rounds += bool(self.hosts[i].agent.gossip_once())
+        elif x < p_write + p_gossip + p_kill:
+            alive = [h for h in self.hosts if h.node.alive]
+            if len(alive) > 1:
+                self.rng.choice(alive).node.set_alive(False)
+                r.kills += 1
+        elif x < p_write + p_gossip + p_kill + p_revive:
+            dead = [h for h in self.hosts if not h.node.alive]
+            if dead:
+                self.rng.choice(dead).node.set_alive(True)
+                r.revivals += 1
+        elif x < p_write + p_gossip + p_kill + p_revive + p_compact:
+            # coordinator barrier from host 0 (skipped while any member is
+            # down — network_compact cannot prove stability without them)
+            if self.hosts[0].agent.compact_once():
+                r.barriers += 1
+            else:
+                r.barriers_skipped += 1
+        else:
+            pass  # idle step
+        r.steps += 1
+
+    def heal_and_check(self, max_rounds: int = 200) -> SoakReport:
+        r = self.report
+        for h in self.hosts:
+            h.node.set_alive(True)
+        rounds = 0
+        while True:
+            states = [h.node.get_state() for h in self.hosts]
+            if all(s == states[0] for s in states[1:]):
+                break
+            assert rounds < max_rounds, "liveness violated (I3)"
+            for h in self.hosts:
+                h.agent.gossip_once()
+            rounds += 1
+        r.rounds_to_converge = rounds
+        want = OracleReplica.converged_state(self.oracles)
+        got = self.hosts[0].node.get_state()
+        assert got == want, f"durability violated (I1): {got} != {want}"
+        r.final_state = got
+        return r
+
+    def run(self, n_steps: int) -> SoakReport:
+        try:
+            for _ in range(n_steps):
+                self.step()
+            return self.heal_and_check()
+        finally:
+            self.close()
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -220,6 +341,8 @@ def main(argv=None) -> int:
                     help="ALSO run scheduled barriers every N ticks")
     ap.add_argument("--full-gossip", action="store_true",
                     help="ship full logs every round instead of deltas")
+    ap.add_argument("--network", action="store_true",
+                    help="run the soak over real sockets (NetworkSoakRunner)")
     ap.add_argument("--platform", choices=["cpu", "tpu", "ambient"],
                     default="cpu",
                     help="JAX backend (default cpu: the soak is a host-path "
@@ -231,6 +354,10 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
     for seed in range(args.seeds):
+        if args.network:
+            print(f"seed {seed}: "
+                  f"{NetworkSoakRunner(n=args.replicas, seed=seed).run(args.steps)}")
+            continue
         runner = SoakRunner(
             ClusterConfig(
                 n_replicas=args.replicas,

@@ -48,3 +48,15 @@ def test_soak_reference_topology():
     cfg = ClusterConfig(n_replicas=5, reference_topology=True)
     r = SoakRunner(cfg, seed=5).run(300)
     assert r.final_state
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_network_soak(seed):
+    """The soak over real sockets: HTTP writes, delta gossip, alive-toggle
+    faults, coordinator barriers — same four invariants."""
+    from crdt_tpu.harness.soak import NetworkSoakRunner
+
+    r = NetworkSoakRunner(n=3, seed=seed).run(250)
+    assert r.writes_accepted > 0
+    assert r.final_state
+    assert r.barriers + r.barriers_skipped > 0
