@@ -34,7 +34,7 @@ R = 1 << 20      # 1M replicas (north-star scale)
 N_NODES = 8
 BANK = 16        # distinct peer states cycled through the loop
 K_SMALL, K_LARGE = 64, 512
-REPS = 5
+REPS = 7
 
 
 @partial(jax.jit, static_argnames="k")
@@ -47,14 +47,26 @@ def chained_merges(a, bank, k):
     return out.sum()  # 8-byte result; fetching it forces completion
 
 
-def timed(a, bank, k):
-    _ = int(chained_merges(a, bank, k))  # compile + warm
-    best = float("inf")
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        _ = int(chained_merges(a, bank, k))
-        best = min(best, time.perf_counter() - t0)
-    return best
+MIN_DIFF_S = 0.15  # the K-delta must dwarf tunnel-RTT jitter AND slow drift
+
+
+def _once(a, bank, k):
+    t0 = time.perf_counter()
+    _ = int(chained_merges(a, bank, k))
+    return time.perf_counter() - t0
+
+
+def paired_diff(a, bank, k_small, k_large, reps=REPS):
+    """Median of INTERLEAVED (t_large - t_small) pairs: relay/chip
+    throughput drifts over seconds, so measuring all-small then all-large
+    bakes the drift into the quotient; back-to-back pairs cancel it."""
+    _ = int(chained_merges(a, bank, k_small))  # compile + warm both
+    _ = int(chained_merges(a, bank, k_large))
+    diffs = sorted(
+        _once(a, bank, k_large) - _once(a, bank, k_small)
+        for _ in range(reps)
+    )
+    return diffs[len(diffs) // 2]
 
 
 def main():
@@ -62,9 +74,17 @@ def main():
     a = jax.random.randint(ka, (R, N_NODES), 0, 1 << 20, dtype=jnp.int32)
     bank = jax.random.randint(kb, (BANK, R, N_NODES), 0, 1 << 20, dtype=jnp.int32)
 
-    t_small = timed(a, bank, K_SMALL)
-    t_large = timed(a, bank, K_LARGE)
-    per_merge = (t_large - t_small) / (K_LARGE - K_SMALL)
+    # adaptive K: grow until the time delta dwarfs dispatch jitter.  dk is
+    # captured WITH its diff — pairing the last diff with a post-scaled
+    # K-delta would inflate the result 4x on loop exhaustion
+    k_small, k_large = K_SMALL, K_LARGE
+    for _ in range(4):
+        diff = paired_diff(a, bank, k_small, k_large)
+        dk = k_large - k_small
+        if diff >= MIN_DIFF_S:
+            break
+        k_small, k_large = k_small * 4, k_large * 4
+    per_merge = diff / dk
 
     merges_per_sec = R / per_merge
     print(

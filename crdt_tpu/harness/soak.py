@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import sys
 from typing import Dict, List, Optional
 
 from crdt_tpu.api.cluster import LocalCluster
@@ -45,6 +46,15 @@ class SoakReport:
     barriers_skipped: int
     rounds_to_converge: int
     final_state: Dict[str, str]
+
+    @classmethod
+    def zero(cls) -> "SoakReport":
+        return cls(
+            steps=0, writes_offered=0, writes_accepted=0,
+            writes_rejected_dead=0, gossip_rounds=0, kills=0, revivals=0,
+            barriers=0, barriers_skipped=0, rounds_to_converge=-1,
+            final_state={},
+        )
 
     def __str__(self) -> str:
         return (
@@ -91,12 +101,7 @@ class SoakRunner:
             max_dead if max_dead is not None
             else len(self.cluster.nodes) - 1
         )
-        self.report = SoakReport(
-            steps=0, writes_offered=0, writes_accepted=0,
-            writes_rejected_dead=0, gossip_rounds=0, kills=0, revivals=0,
-            barriers=0, barriers_skipped=0, rounds_to_converge=-1,
-            final_state={},
-        )
+        self.report = SoakReport.zero()
 
     # ---- schedule actions ----
 
@@ -218,6 +223,11 @@ class NetworkSoakRunner:
     fault model is /condition-style alive toggling, so 'down' daemons
     refuse service while their server keeps listening — exactly the
     reference's failure mode (its process never dies either).
+
+    NOTE: step()/heal_and_check() deliberately parallel SoakRunner's
+    (different actions and convergence predicates, same invariant set) —
+    a change to either schedule shape should be mirrored, or divergence
+    justified, in the other.
     """
 
     def __init__(
@@ -230,11 +240,15 @@ class NetworkSoakRunner:
         p_revive: float = 0.09,
         p_compact: float = 0.1,
         n_keys: int = 6,
+        config: Optional[ClusterConfig] = None,
     ):
         from crdt_tpu.api.net import NodeHost, RemotePeer
 
         self.rng = random.Random(seed)
-        self.hosts = [NodeHost(rid=r, peers=[]) for r in range(n)]
+        config = config or ClusterConfig()
+        self.hosts = [
+            NodeHost(rid=r, peers=[], config=config) for r in range(n)
+        ]
         for h in self.hosts:
             h.agent.peers = [
                 RemotePeer(o.url) for o in self.hosts if o is not h
@@ -244,12 +258,7 @@ class NetworkSoakRunner:
         self.oracles = [OracleReplica(rid=r) for r in range(n)]
         self.p = (p_write, p_gossip, p_kill, p_revive, p_compact)
         self.keys = [f"k{i}" for i in range(n_keys)]
-        self.report = SoakReport(
-            steps=0, writes_offered=0, writes_accepted=0,
-            writes_rejected_dead=0, gossip_rounds=0, kills=0, revivals=0,
-            barriers=0, barriers_skipped=0, rounds_to_converge=-1,
-            final_state={},
-        )
+        self.report = SoakReport.zero()
 
     def close(self) -> None:
         for h in self.hosts:
@@ -355,8 +364,16 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", args.platform)
     for seed in range(args.seeds):
         if args.network:
-            print(f"seed {seed}: "
-                  f"{NetworkSoakRunner(n=args.replicas, seed=seed).run(args.steps)}")
+            if args.compact_every:
+                print("note: --compact-every is schedule-driven in "
+                      "--network mode (the agents' timer loops are not "
+                      "running); barriers come from the p_compact action",
+                      file=sys.stderr)
+            runner = NetworkSoakRunner(
+                n=args.replicas, seed=seed,
+                config=ClusterConfig(delta_gossip=not args.full_gossip),
+            )
+            print(f"seed {seed}: {runner.run(args.steps)}")
             continue
         runner = SoakRunner(
             ClusterConfig(
@@ -371,6 +388,4 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    import sys
-
     sys.exit(main())
