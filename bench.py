@@ -84,7 +84,13 @@ def main():
         if diff >= MIN_DIFF_S:
             break
         k_small, k_large = k_small * 4, k_large * 4
-    per_merge = diff / dk
+    else:
+        print(
+            f"# WARNING: diff {diff:.3e}s never cleared the {MIN_DIFF_S}s "
+            f"noise floor (K up to {k_large}); rate below is unreliable",
+            file=sys.stderr,
+        )
+    per_merge = max(diff, 1e-9) / dk
 
     merges_per_sec = R / per_merge
     print(

@@ -362,13 +362,12 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.network and args.compact_every:
+        print("note: --compact-every is schedule-driven in --network mode "
+              "(the agents' timer loops are not running); barriers come "
+              "from the p_compact action", file=sys.stderr)
     for seed in range(args.seeds):
         if args.network:
-            if args.compact_every:
-                print("note: --compact-every is schedule-driven in "
-                      "--network mode (the agents' timer loops are not "
-                      "running); barriers come from the p_compact action",
-                      file=sys.stderr)
             runner = NetworkSoakRunner(
                 n=args.replicas, seed=seed,
                 config=ClusterConfig(delta_gossip=not args.full_gossip),
