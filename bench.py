@@ -17,9 +17,14 @@ Measurement notes (both matter on this tunnel-attached chip):
   traffic as a real merge.
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "p50_merge_latency_us": N, "p99_merge_latency_us": N, "latency_samples": N}
 vs_baseline is value / 100e6 (the BASELINE target; the reference publishes
-no numbers of its own — BASELINE.md "published: none").
+no numbers of its own — BASELINE.md "published: none").  The latency
+quantiles answer the second half of the north-star metric ("p50 merge
+latency"): each sample is an independent paired-difference estimate of the
+time for ONE full 1M-replica merge (same bank-of-peers loop), so p50/p99
+are quantiles over device-timed per-merge samples, in microseconds.
 """
 import json
 import sys
@@ -35,6 +40,7 @@ N_NODES = 8
 BANK = 16        # distinct peer states cycled through the loop
 K_SMALL, K_LARGE = 64, 512
 REPS = 7
+QUANTILE_REPS = 15  # latency-quantile sample count at the final K pair
 
 
 @partial(jax.jit, static_argnames="k")
@@ -56,17 +62,23 @@ def _once(a, bank, k):
     return time.perf_counter() - t0
 
 
-def paired_diff(a, bank, k_small, k_large, reps=REPS):
-    """Median of INTERLEAVED (t_large - t_small) pairs: relay/chip
-    throughput drifts over seconds, so measuring all-small then all-large
-    bakes the drift into the quotient; back-to-back pairs cancel it."""
+def paired_diffs(a, bank, k_small, k_large, reps=REPS):
+    """Sorted INTERLEAVED (t_large - t_small) pairs: relay/chip throughput
+    drifts over seconds, so measuring all-small then all-large bakes the
+    drift into the quotient; back-to-back pairs cancel it.  Each diff is an
+    independent device-timed estimate of (k_large - k_small) merges."""
     _ = int(chained_merges(a, bank, k_small))  # compile + warm both
     _ = int(chained_merges(a, bank, k_large))
-    diffs = sorted(
+    return sorted(
         _once(a, bank, k_large) - _once(a, bank, k_small)
         for _ in range(reps)
     )
-    return diffs[len(diffs) // 2]
+
+
+def _quantile(sorted_xs, q):
+    """Nearest-rank quantile of an ascending list (no numpy dependency)."""
+    i = min(len(sorted_xs) - 1, max(0, round(q * (len(sorted_xs) - 1))))
+    return sorted_xs[int(i)]
 
 
 def main():
@@ -79,7 +91,8 @@ def main():
     # K-delta would inflate the result 4x on loop exhaustion
     k_small, k_large = K_SMALL, K_LARGE
     for _ in range(4):
-        diff = paired_diff(a, bank, k_small, k_large)
+        diffs = paired_diffs(a, bank, k_small, k_large)
+        diff = diffs[len(diffs) // 2]
         dk = k_large - k_small
         if diff >= MIN_DIFF_S:
             break
@@ -90,9 +103,16 @@ def main():
             f"noise floor (K up to {k_large}); rate below is unreliable",
             file=sys.stderr,
         )
-    per_merge = max(diff, 1e-9) / dk
 
-    merges_per_sec = R / per_merge
+    # latency quantiles at the settled K pair: more independent samples of
+    # the same paired-difference estimator, each divided by dk = seconds
+    # for ONE full 1M-replica merge (device-timed; RTT cancelled per pair)
+    samples = paired_diffs(a, bank, k_small, k_large, reps=QUANTILE_REPS)
+    per_merge_samples = [max(d, 1e-9) / dk for d in samples]
+    p50 = _quantile(per_merge_samples, 0.50)
+    p99 = _quantile(per_merge_samples, 0.99)
+
+    merges_per_sec = R / p50
     print(
         json.dumps(
             {
@@ -100,6 +120,9 @@ def main():
                 "value": round(merges_per_sec, 1),
                 "unit": "replica-merges/s",
                 "vs_baseline": round(merges_per_sec / TARGET, 3),
+                "p50_merge_latency_us": round(p50 * 1e6, 3),
+                "p99_merge_latency_us": round(p99 * 1e6, 3),
+                "latency_samples": len(per_merge_samples),
             }
         )
     )

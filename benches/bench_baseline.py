@@ -116,15 +116,17 @@ def bench_gcounter_pair(results, tiny):
           "2-replica increment+merge, 8 writer slots (reference default path)")
 
 
-def bench_pncounter_vmap(results, tiny):
-    """1K replicas, batched PN-Counter join: both planes, one fused max."""
+def bench_pncounter_vmap(results, tiny, r=None, bank_n=8, suffix=""):
+    """1K replicas, batched PN-Counter join: both planes, one fused max.
+    Reused at 1M replicas (bench_pncounter_1m) for the north-star-scale
+    datapoint showing the PN family saturates HBM like the G-Counter."""
     import jax
     import jax.numpy as jnp
 
     from crdt_tpu.models import pncounter
 
-    r = 64 if tiny else 1024
-    bank_n, nodes = 8, 64
+    r = r or (64 if tiny else 1024)
+    nodes = 64
     ks = jax.random.split(jax.random.key(2), 3)
     c = pncounter.PNCounter(
         pos=jax.random.randint(ks[0], (r, nodes), 0, 1 << 20, dtype=jnp.int32),
@@ -144,11 +146,20 @@ def bench_pncounter_vmap(results, tiny):
         pos, neg = jax.lax.fori_loop(0, k, body, (c.pos, c.neg))
         return pos.sum() - neg.sum()
 
-    ks_, kl = (8, 32) if tiny else (256, 2048)
+    ks_, kl = (8, 32) if tiny else ((64, 512) if r >= 1 << 20 else (256, 2048))
     per = _timed(lambda k: int(chained(c, bank, k)), ks_, kl,
                  min_diff=0 if tiny else MIN_DIFF_S)
-    _emit(results, "pncounter_vmap_replica_merges_per_sec", r / per,
+    _emit(results, f"pncounter_vmap_replica_merges_per_sec{suffix}", r / per,
           "replica-merges/s", f"{r}-replica batched PN join, {nodes} slots")
+
+
+def bench_pncounter_1m(results, tiny):
+    """North-star-scale PN point (VERDICT round 1 #9): 1M replicas x 64
+    slots x 2 planes.  Bank shrinks to 4 peers: 4 x 2 x 1M x 64 x 4 B =
+    2 GB resident."""
+    bench_pncounter_vmap(
+        results, tiny, r=(256 if tiny else 1 << 20), bank_n=4, suffix="_1m"
+    )
 
 
 def bench_lww_argmax(results, tiny):
@@ -191,17 +202,42 @@ def bench_lww_argmax(results, tiny):
           "replica-merges/s", f"{r}-register (ts, rid) argmax join")
 
 
-def bench_orset_union(results, tiny, lanes=None, capacity=None):
-    """Columnar Pallas sorted-segment union (BASELINE hard config)."""
+def _enable_compile_cache():
+    """Persistent XLA/Mosaic compilation cache: the fused union kernel at
+    C=1024 costs ~270 s to Mosaic-compile; the striped 1M driver and the
+    lane sweep reuse byte-identical kernels across stripes/processes, so
+    the cache turns 8+ such compiles into one."""
+    import jax
+
+    if jax.config.jax_compilation_cache_dir is None:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/root/.cache/jax_compilation")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def _orset_union_rate(seed, c, ln, tiny, bank_n=None, chained_fn_cache={}):
+    """Measured per-union seconds for a C-tag x ln-lane columnar union
+    (None off-TPU after an interpret-mode smoke union).  Shared by the
+    single-shape bench, the lane sweep, and the 1M striped driver.
+
+    ``chained_fn_cache`` (intentionally shared across calls) holds ONE
+    jitted chain per (c, ln, bank_n) so the 8-stripe 1M driver compiles
+    once, not once per stripe."""
     import jax
     import jax.numpy as jnp
+
+    _enable_compile_cache()
 
     from crdt_tpu.ops import pallas_union
     from crdt_tpu.utils.constants import SENTINEL
 
-    c = capacity or (64 if tiny else 1024)
-    ln = lanes or (128 if tiny else 1 << 17)  # 128K lanes is HBM-safe
-    bank_n = 2
+    # HBM budget (v5e: 16 GB): inputs 2·C·ln·4 B (a) + bank_n·2·C·ln·4 B,
+    # outputs 2·C·ln·4 B transient (out_size=C in-kernel truncation).  At
+    # 512K lanes a C=1024 array is 2 GB, so shrink the bank to ONE peer —
+    # the loop body stays collapse-proof because pallas_call is an opaque
+    # custom call XLA cannot algebraically simplify (unlike jnp.maximum).
+    if bank_n is None:
+        bank_n = 1 if c * ln * 4 >= (1 << 31) else 2
     interpret = jax.default_backend() != "tpu"
 
     def cols(key, fill):
@@ -210,25 +246,30 @@ def bench_orset_union(results, tiny, lanes=None, capacity=None):
         keys = jnp.where(jnp.arange(c)[:, None] < fill, ks, SENTINEL)
         return keys, (ks & 1).astype(jnp.int32)
 
-    kk = jax.random.split(jax.random.key(4), bank_n + 1)
+    kk = jax.random.split(jax.random.key(seed), bank_n + 1)
     ka, va = cols(kk[0], c // 2)
     bank = [cols(k2, c // 2) for k2 in kk[1:]]
     bank_k = jnp.stack([b[0] for b in bank])
     bank_v = jnp.stack([b[1] for b in bank])
 
-    @partial(jax.jit, static_argnames="k")
-    def chained(ka, va, bank_k, bank_v, k):
-        def body(i, carry):
-            kx, vx = carry
-            j = i % bank_n
-            kb = jax.lax.dynamic_index_in_dim(bank_k, j, keepdims=False)
-            vb = jax.lax.dynamic_index_in_dim(bank_v, j, keepdims=False)
-            ko, vo, _ = pallas_union.sorted_union_columnar(
-                kx, vx, kb, vb, out_size=c, interpret=interpret)
-            return ko, vo
+    cache_key = (c, ln, bank_n, interpret)
+    if cache_key not in chained_fn_cache:
+        @partial(jax.jit, static_argnames="k")
+        def chained(ka, va, bank_k, bank_v, k):
+            def body(i, carry):
+                kx, vx = carry
+                j = i % bank_n
+                kb = jax.lax.dynamic_index_in_dim(bank_k, j, keepdims=False)
+                vb = jax.lax.dynamic_index_in_dim(bank_v, j, keepdims=False)
+                ko, vo, _ = pallas_union.sorted_union_columnar(
+                    kx, vx, kb, vb, out_size=c, interpret=interpret)
+                return ko, vo
 
-        ko, vo = jax.lax.fori_loop(0, k, body, (ka, va))
-        return ko.sum() + vo.sum()
+            ko, vo = jax.lax.fori_loop(0, k, body, (ka, va))
+            return ko.sum() + vo.sum()
+
+        chained_fn_cache[cache_key] = chained
+    chained = chained_fn_cache[cache_key]
 
     if interpret:
         # interpret-pallas inside fori_loop is pathologically slow: one eager
@@ -236,16 +277,78 @@ def bench_orset_union(results, tiny, lanes=None, capacity=None):
         out = pallas_union.sorted_union_columnar(
             ka, va, bank_k[0], bank_v[0], out_size=c, interpret=True)
         jax.block_until_ready(out)
-        _emit(results, "orset_pallas_union_smoke", 1, "ok",
-              f"interpret-mode union C={c} lanes={ln} (no TPU)")
-        return
+        return None
     ks_, kl = (2, 6) if tiny else (8, 32)
     per = _timed(lambda k: int(chained(ka, va, bank_k, bank_v, k)), ks_, kl,
                  min_diff=0 if tiny else MIN_DIFF_S)
+    # free this shape's operands before the caller builds the next stripe
+    del ka, va, bank_k, bank_v, bank
+    return per
+
+
+def bench_orset_union(results, tiny, lanes=None, capacity=None):
+    """Columnar Pallas sorted-segment union (BASELINE hard config)."""
+    c = capacity or (64 if tiny else 1024)
+    ln = lanes or (128 if tiny else 1 << 17)  # 128K lanes is HBM-safe
+    per = _orset_union_rate(4, c, ln, tiny)
+    if per is None:
+        _emit(results, "orset_pallas_union_smoke", 1, "ok",
+              f"interpret-mode union C={c} lanes={ln} (no TPU)")
+        return
     _emit(results, "orset_pallas_replica_unions_per_sec", ln / per,
           "replica-unions/s",
           f"bitonic-merge union, C={c} tags x {ln} replicas "
-          f"(rate is lane-linear; BASELINE shape 1M x 1K)")
+          f"(1M-lane BASELINE shape measured by the striped driver below; "
+          f"linearity measured by --sweep)")
+
+
+def bench_orset_sweep(results, tiny):
+    """Measured lane sweep (128K -> 256K -> 512K at C=1024): the evidence
+    for lane-linearity that round 1 merely asserted.  At 512K lanes the
+    operand set only fits because out_size=C truncation happens in-kernel
+    and the peer bank shrinks to one entry (see _orset_union_rate)."""
+    c = 64 if tiny else 1024
+    lanes = (128, 256, 512) if tiny else (1 << 17, 1 << 18, 1 << 19)
+    for ln in lanes:
+        per = _orset_union_rate(4, c, ln, tiny)
+        if per is None:
+            _emit(results, f"orset_sweep_{ln}_smoke", 1, "ok",
+                  "interpret-mode (no TPU)")
+            continue
+        _emit(results, f"orset_unions_per_sec_{ln // 1024}k_lanes",
+              ln / per, "replica-unions/s",
+              f"C={c}, {ln} lanes ({per * 1e3:.1f} ms/union)")
+
+
+def bench_orset_1m(results, tiny):
+    """The OR-Set BASELINE config at its TRUE shape: C=1024 tags x 1M
+    lanes, measured (not extrapolated).  A single pallas_call at this shape
+    cannot run — the four operands alone are 4 x 4 GB = 16 GB, the v5e's
+    entire HBM — so the driver is host-striped: 8 stripes x 128K lanes,
+    each stripe's buffers freed before the next is built (the carry buffers
+    inside each stripe's fori_loop are donated/reused by XLA).  The
+    reported time for one 1M-lane union is the SUM of the per-stripe
+    per-union times — i.e. exactly how this workload must execute on one
+    chip — and the aggregate rate is 2^20 lanes / that sum."""
+    stripes = 2 if tiny else 8
+    c = 64 if tiny else 1024
+    stripe_lanes = 256 if tiny else 1 << 17
+    pers = []
+    for s in range(stripes):
+        per = _orset_union_rate(100 + s, c, stripe_lanes, tiny)
+        if per is None:
+            _emit(results, "orset_1m_striped_smoke", 1, "ok",
+                  f"interpret-mode striped driver x{stripes} (no TPU)")
+            return
+        pers.append(per)
+    total = sum(pers)
+    n_lanes = stripes * stripe_lanes
+    _emit(results, "orset_pallas_unions_per_sec_1m_striped",
+          n_lanes / total, "replica-unions/s",
+          f"MEASURED at BASELINE shape: C={c} x {n_lanes} lanes as "
+          f"{stripes} x {stripe_lanes}-lane stripes; one full union = "
+          f"{total * 1e3:.0f} ms (per-stripe {min(pers) * 1e3:.1f}-"
+          f"{max(pers) * 1e3:.1f} ms)")
 
 
 def bench_gossip_allreduce(results, tiny):
@@ -292,8 +395,11 @@ def bench_gossip_allreduce(results, tiny):
 ALL = {
     "gcounter_pair": bench_gcounter_pair,
     "pncounter_vmap": bench_pncounter_vmap,
+    "pncounter_1m": bench_pncounter_1m,
     "lww_argmax": bench_lww_argmax,
     "orset_union": bench_orset_union,
+    "orset_sweep": bench_orset_sweep,
+    "orset_1m": bench_orset_1m,
     "gossip_allreduce": bench_gossip_allreduce,
 }
 

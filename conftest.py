@@ -20,3 +20,19 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--long",
+        action="store_true",
+        default=False,
+        help="run the scaled-up fuzz schedules (50+ seeds x 500+ writes; "
+        "see tests/test_parity_fuzz.py and PARITY.md).  CRDT_LONG=1 in the "
+        "environment does the same for bare `pytest` invocations.",
+    )
+
+
+def pytest_configure(config):
+    if os.environ.get("CRDT_LONG"):
+        config.option.long = True

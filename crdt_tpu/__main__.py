@@ -14,9 +14,13 @@ multi-process/multi-host deployment:
     python -m crdt_tpu --daemon --rid 0 --port 8080 --peers http://h2:8080
     python -m crdt_tpu --daemon --rid 1 --port 8080 --peers http://h1:8080
 
-Both modes speak the reference wire format, so a fleet can mix these with
-the original Go server (mixed fleets: leave --compact-every at 0; see
-crdt_tpu.api.node).
+Go interop is ONE-DIRECTIONAL: these replicas can pull from and merge an
+original Go server's payloads (plain unix-ms keys arrive as rid=-1 foreign
+ops), but a Go server must never pull from a crdt_tpu replica — its gossip
+loop Atoi's each key and returns on the first "ts:rid:seq" key it meets
+(main.go:251-254, quirk §0.1.8), permanently killing that Go replica's
+anti-entropy.  In a fleet containing Go peers, also leave --compact-every
+at 0 (compaction payload sections are not Go-parseable; crdt_tpu.api.node).
 """
 from __future__ import annotations
 
@@ -112,13 +116,35 @@ def run_daemon(args) -> int:
         delta_gossip=not args.full_gossip,
     )
     peers = [u for u in (args.peers or "").split(",") if u]
+    rid = args.rid
+    incarnation = 0
+    if args.checkpoint_dir:
+        # crash recovery: claim a fresh boot incarnation (persisted before
+        # serving) and write under a per-incarnation rid, so a restored
+        # daemon can never re-mint (rid, seq) pairs its dead predecessor
+        # may have gossiped out (utils/checkpoint.py module docstring)
+        if not 0 <= args.rid < args.rid_stride:
+            # rid >= stride would alias another slot's incarnation rid
+            # (e.g. base 64 == base 0 at incarnation 1), recreating the
+            # exact (rid, seq) collision the incarnation scheme prevents
+            print(f"--checkpoint-dir requires 0 <= --rid < --rid-stride "
+                  f"(got rid={args.rid}, stride={args.rid_stride}): base "
+                  "rids share the incarnation id space", file=sys.stderr)
+            return 2
+        from crdt_tpu.utils.checkpoint import bump_incarnation
+
+        incarnation = bump_incarnation(args.checkpoint_dir)
+        rid = args.rid + args.rid_stride * incarnation
     host = NodeHost(
-        rid=args.rid, peers=peers, port=args.port, config=cfg,
+        rid=rid, peers=peers, port=args.port, config=cfg,
         coordinator=args.coordinator,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every_s=args.checkpoint_every_s,
     )
     host.start()
-    print(f"replica rid={args.rid} serving on {host.url}, "
-          f"{len(peers)} peer(s)")
+    print(f"replica rid={rid} (base {args.rid}, incarnation {incarnation}, "
+          f"restored={host.restored}) serving on {host.url}, "
+          f"{len(peers)} peer(s)", flush=True)
     t_end = time.time() + args.duration if args.duration else None
     try:
         while t_end is None or time.time() < t_end:
@@ -173,6 +199,16 @@ def main(argv=None) -> int:
     ap.add_argument("--coordinator", action="store_true",
                     help="daemon: schedule cross-fleet compaction barriers "
                          "from this process (exactly one per fleet)")
+    ap.add_argument("--checkpoint-dir", type=str, default=None,
+                    help="daemon: crash-safe snapshot directory; on boot, "
+                         "restore the newest snapshot and claim a fresh "
+                         "incarnation (rid += stride * incarnation)")
+    ap.add_argument("--checkpoint-every-s", type=float, default=0,
+                    help="daemon: periodic snapshot interval (0 = only "
+                         "explicit POST /admin/checkpoint)")
+    ap.add_argument("--rid-stride", type=int, default=64,
+                    help="daemon: writer-id stride between boot "
+                         "incarnations of one checkpoint dir")
     ap.add_argument("--platform", choices=["cpu", "tpu", "ambient"],
                     default="cpu",
                     help="JAX backend for the host runtime (default cpu: "

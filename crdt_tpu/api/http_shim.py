@@ -13,6 +13,13 @@ compaction barrier, crdt_tpu.api.net.network_compact):
   GET  /vv                      {"vv": {rid: seq}, "frontier": {rid: seq}}
   POST /compact                 {"frontier": {rid: seq}} -> fold + prune
 
+Daemon admin extensions (present only when the handler is built with an
+``admin`` object — a NodeHost; used by the crash soak to drive a daemon
+fleet deterministically, crdt_tpu.harness.crashsoak):
+  POST /admin/pull              {"peer": url?} -> one gossip pull now
+  POST /admin/barrier           one compaction barrier now (coordinator)
+  POST /admin/checkpoint        crash-safe snapshot now
+
 The /condition route takes the flag as a path segment (also accepted:
 ?alive_status=) — the reference registered the route without the parameter
 binding so every call 500'd (quirk §0.1.7); this shim implements what that
@@ -29,7 +36,7 @@ from urllib.parse import parse_qs, urlparse
 from crdt_tpu.api.cluster import LocalCluster
 
 
-def _make_handler(cluster: LocalCluster, idx: int):
+def _make_handler(cluster: LocalCluster, idx: int, admin=None):
     class Handler(BaseHTTPRequestHandler):
         # resolve at request time: a node may be replaced in the cluster
         # (crash + checkpoint-restore) and the port must follow it
@@ -112,6 +119,42 @@ def _make_handler(cluster: LocalCluster, idx: int):
 
         def do_POST(self):
             path = urlparse(self.path).path
+            if path.startswith("/admin/") and admin is not None:
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._send(400, "invalid body")
+                    return
+                try:
+                    if path == "/admin/pull":
+                        ok = admin.admin_pull(body.get("peer"))
+                        self._send(200, json.dumps({"pulled": bool(ok)}),
+                                   "application/json")
+                    elif path == "/admin/barrier":
+                        frontier = admin.admin_barrier()
+                        self._send(
+                            200,
+                            json.dumps({
+                                "frontier": {str(r): s
+                                             for r, s in frontier.items()}
+                            }),
+                            "application/json",
+                        )
+                    elif path == "/admin/checkpoint":
+                        snap = admin.checkpoint_now()
+                        if snap is None:
+                            self._send(400, "no checkpoint dir configured")
+                        else:
+                            self._send(200, json.dumps({"snapshot": snap}),
+                                       "application/json")
+                    else:
+                        self._send(404, "not found")
+                except Exception as e:  # surfaced to the driving test: a
+                    # failing pull/barrier is an invariant violation (I4),
+                    # never a silent skip (the reference's quirk 0.1.8)
+                    self._send(500, f"{type(e).__name__}: {e}")
+                return
             if path == "/compact":
                 n = int(self.headers.get("Content-Length", 0))
                 try:

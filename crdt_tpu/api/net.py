@@ -281,6 +281,8 @@ class NodeHost:
         config: Optional[ClusterConfig] = None,
         capacity: Optional[int] = None,
         coordinator: bool = False,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every_s: float = 0,
     ):
         from crdt_tpu.api.http_shim import _make_handler
 
@@ -288,16 +290,30 @@ class NodeHost:
         self.node = ReplicaNode(
             rid=rid, capacity=capacity or self.config.log_capacity
         )
+        # crash recovery: restore the newest complete snapshot (if any)
+        # BEFORE serving.  The caller is responsible for minting rid via
+        # checkpoint.bump_incarnation when restores can land in a live
+        # fleet (see utils/checkpoint.py module docstring).
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every_s = checkpoint_every_s
+        self.restored = False
+        if checkpoint_dir:
+            from crdt_tpu.utils import checkpoint as ckpt
+
+            self.restored = ckpt.load_latest_node(checkpoint_dir, self.node)
         self.nodes = [self.node]  # duck-types as a cluster for the handler
         self.agent = NetworkAgent(
             self.node, peers, self.config, coordinator=coordinator
         )
         self._server = ThreadingHTTPServer(
-            (host, port), _make_handler(self, 0)
+            (host, port), _make_handler(self, 0, admin=self)
         )
         self.port: int = self._server.server_address[1]
         self.url = f"http://{host}:{self.port}"
         self._server_thread: Optional[threading.Thread] = None
+        self._ckpt_stop = threading.Event()
+        self._ckpt_thread: Optional[threading.Thread] = None
+        self._ckpt_errors: List[Exception] = []
 
     def start_server(self) -> None:
         """Serve the HTTP surface only (no background gossip) — for drivers
@@ -317,9 +333,62 @@ class NodeHost:
     def start(self) -> None:
         self.start_server()
         self.agent.start()
+        if self.checkpoint_dir and self.checkpoint_every_s > 0:
+            self._ckpt_stop.clear()
+            self._ckpt_thread = threading.Thread(
+                target=self._ckpt_loop, daemon=True
+            )
+            self._ckpt_thread.start()
 
     def stop(self) -> None:
         try:
+            self._ckpt_stop.set()
+            if self._ckpt_thread is not None:
+                self._ckpt_thread.join(timeout=5)
+                self._ckpt_thread = None
             self.agent.stop()
+            if self._ckpt_errors:
+                raise RuntimeError(
+                    f"{len(self._ckpt_errors)} periodic checkpoint(s) failed"
+                ) from self._ckpt_errors[0]
         finally:
             self.stop_server()
+
+    def _ckpt_loop(self) -> None:
+        # a transient failure (disk full, EIO) must not silently end
+        # periodic checkpointing: record + retry next period, and surface
+        # the failures through stop() like the gossip loop's errors
+        while not self._ckpt_stop.wait(self.checkpoint_every_s):
+            try:
+                self.checkpoint_now()
+            except Exception as e:  # noqa: BLE001 — surfaced via stop()
+                self.agent.metrics.inc("checkpoint_errors")
+                self._ckpt_errors.append(e)
+
+    # ---- admin drive surface (POST /admin/*, crash-soak determinism) ----
+
+    def checkpoint_now(self) -> Optional[str]:
+        """Crash-safe snapshot (atomic versioned dir + LATEST repoint)."""
+        if not self.checkpoint_dir:
+            return None
+        from crdt_tpu.utils import checkpoint as ckpt
+
+        return ckpt.save_node_atomic(self.checkpoint_dir, self.node)
+
+    def admin_pull(self, peer_url: Optional[str] = None) -> bool:
+        """One anti-entropy pull, now, from ``peer_url`` (or a random
+        configured peer) — deterministic external gossip drive."""
+        if peer_url is None:
+            return self.agent.gossip_once()
+        return pull_round(
+            self.node,
+            RemotePeer(peer_url).gossip_payload,
+            self.agent.metrics,
+            delta=self.config.delta_gossip,
+            prefix="net_gossip",
+        )
+
+    def admin_barrier(self) -> dict:
+        """One compaction barrier, now (this host must be the fleet's
+        single coordinator)."""
+        return self.agent.compact_once()

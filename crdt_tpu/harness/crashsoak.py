@@ -1,0 +1,434 @@
+"""Crash-recovery soak: REAL process kills against a daemon fleet (VERDICT
+round 1 #3) — the layer the in-process soaks cannot reach.
+
+`SoakRunner`/`NetworkSoakRunner` (crdt_tpu.harness.soak) inject faults via
+alive-flag toggles: the process survives, so nothing is ever actually lost.
+This runner spawns each replica as a SUBPROCESS (`python -m crdt_tpu
+--daemon --checkpoint-dir ...`), SIGKILLs daemons mid-schedule, and
+restarts them restoring from their crash-safe snapshots INTO THE LIVE
+FLEET while compaction barriers keep running — exactly the combination the
+round-1 verdict called out as untested (a node restored from a pre-barrier
+snapshot carries a stale compaction frontier; the chain rule must absorb
+it).
+
+Fault/durability model (gossip-as-checkpoint, SURVEY.md §5):
+
+* A SIGKILL loses every op the daemon minted after its last snapshot —
+  UNLESS a peer already pulled it.  The fleet's surviving ops for writer w
+  are therefore a per-writer prefix 0..VV[w] where VV is the healed
+  fleet's converged version vector.
+* A restored daemon boots under a FRESH incarnation rid (see
+  crdt_tpu/utils/checkpoint.py): its dead predecessor's ops are a frozen
+  writer prefix that flows back through ordinary gossip, and no (rid, seq)
+  is ever minted twice.
+
+Invariants checked at heal time:
+
+  I1  durability    — converged state == the oracle fold of exactly the
+                      vv-surviving prefix of accepted writes; additionally
+                      every explicitly checkpointed write DID survive
+                      (VV[rid] >= last-checkpoint watermark), and writers
+                      never killed lost nothing.
+  I2  availability  — a soft-dead daemon 502s writes; a killed one refuses
+                      connections; both count as rejected, never lost-
+                      after-accept.
+  I3  liveness      — the healed fleet (every daemon restarted) converges
+                      within a bounded number of pull rounds.
+  I4  safety        — no admin pull/barrier ever 500s: barriers racing
+                      kills, restores with stale frontiers, and revival
+                      merges are all legal schedules (frontier chain rule).
+
+CLI (long sweeps):  python -m crdt_tpu.harness.crashsoak --steps 300
+CI runs a short seeded schedule (tests/test_crash_soak.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+RID_STRIDE = 64
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _http(url: str, method: str = "GET", body: Optional[dict] = None,
+          timeout: float = 10.0) -> Tuple[int, bytes]:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as res:
+            return res.status, res.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class Daemon:
+    """One replica slot: a subprocess per boot, a stable port, a stable
+    checkpoint dir, and the boot count (the incarnation the NEXT spawn
+    will claim)."""
+
+    def __init__(self, slot: int, port: int, peer_urls: List[str],
+                 ckpt_dir: str, coordinator: bool):
+        self.slot = slot
+        self.port = port
+        self.url = f"http://127.0.0.1:{port}"
+        self.peer_urls = peer_urls
+        self.ckpt_dir = ckpt_dir
+        self.coordinator = coordinator
+        self.boots = 0
+        self.proc: Optional[subprocess.Popen] = None
+
+    @property
+    def wire_rid(self) -> int:
+        """The writer id of the CURRENT boot (matches bump_incarnation)."""
+        return self.slot + RID_STRIDE * (self.boots - 1)
+
+    def spawn(self, wait_s: float = 30.0) -> None:
+        assert self.proc is None or self.proc.poll() is not None
+        argv = [
+            sys.executable, "-m", "crdt_tpu", "--daemon",
+            "--rid", str(self.slot), "--port", str(self.port),
+            "--peers", ",".join(self.peer_urls),
+            "--checkpoint-dir", self.ckpt_dir,
+            "--rid-stride", str(RID_STRIDE),
+            "--gossip-ms", "600000",  # external drive only (determinism)
+        ]
+        if self.coordinator:
+            argv.append("--coordinator")
+        self.proc = subprocess.Popen(
+            argv, cwd=REPO_ROOT,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        self.boots += 1
+        deadline = time.time() + wait_s
+        while time.time() < deadline:
+            try:
+                code, _ = _http(self.url + "/ping", timeout=2)
+                if code == 200:
+                    return
+            except Exception:
+                pass
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"daemon slot {self.slot} exited rc={self.proc.returncode}"
+                )
+            time.sleep(0.1)
+        raise RuntimeError(f"daemon slot {self.slot} never became healthy")
+
+    def sigkill(self) -> None:
+        assert self.proc is not None and self.proc.poll() is None
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    @property
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def shutdown(self) -> None:
+        if self.running:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+
+@dataclasses.dataclass
+class CrashReport:
+    steps: int = 0
+    writes_offered: int = 0
+    writes_accepted: int = 0
+    writes_rejected: int = 0
+    pulls: int = 0
+    barriers: int = 0
+    barriers_empty: int = 0
+    checkpoints: int = 0
+    soft_kills: int = 0
+    soft_revives: int = 0
+    sigkills: int = 0
+    restores: int = 0
+    ops_lost_to_crashes: int = 0
+    rounds_to_converge: int = -1
+    final_keys: int = 0
+
+    def __str__(self) -> str:
+        return (
+            f"crash-soak: {self.steps} steps, {self.writes_accepted}/"
+            f"{self.writes_offered} writes, {self.pulls} pulls, "
+            f"{self.barriers} barriers (+{self.barriers_empty} empty), "
+            f"{self.checkpoints} ckpts, {self.sigkills} SIGKILLs / "
+            f"{self.restores} restores (+{self.soft_kills}/"
+            f"{self.soft_revives} soft), {self.ops_lost_to_crashes} ops "
+            f"crash-lost, converged in {self.rounds_to_converge} rounds, "
+            f"{self.final_keys} keys"
+        )
+
+
+class CrashSoakRunner:
+    """One seeded kill/restore schedule against a subprocess daemon fleet."""
+
+    def __init__(self, n: int = 3, seed: int = 0, n_keys: int = 6,
+                 workdir: Optional[str] = None):
+        self.rng = random.Random(seed)
+        self.keys = [f"k{i}" for i in range(n_keys)]
+        self._tmp = (
+            tempfile.TemporaryDirectory(prefix="crashsoak-")
+            if workdir is None else None
+        )
+        root = pathlib.Path(workdir or self._tmp.name)
+        ports = _free_ports(n)
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        self.daemons = [
+            Daemon(
+                slot=i, port=ports[i],
+                peer_urls=[u for j, u in enumerate(urls) if j != i],
+                ckpt_dir=str(root / f"node{i}"),
+                coordinator=(i == 0),
+            )
+            for i in range(n)
+        ]
+        for d in self.daemons:
+            d.spawn()
+        # oracle side: every accepted write with its minted identity
+        self.ops: List[Tuple[int, int, Dict[str, str]]] = []  # (rid, seq, cmd)
+        self.accepted_per_boot: Dict[int, int] = {}   # wire_rid -> count
+        self.ckpt_watermark: Dict[int, int] = {}      # wire_rid -> count at ckpt
+        self.report = CrashReport()
+
+    # ---- schedule actions ----
+
+    def _write(self) -> None:
+        r = self.report
+        d = self.rng.choice(self.daemons)
+        cmd = {self.rng.choice(self.keys): str(self.rng.randint(-20, 20))}
+        r.writes_offered += 1
+        if not d.running:
+            r.writes_rejected += 1
+            return
+        code, _ = _http(d.url + "/data", "POST", cmd)
+        if code == 200:
+            rid = d.wire_rid
+            seq = self.accepted_per_boot.get(rid, 0)
+            self.accepted_per_boot[rid] = seq + 1
+            self.ops.append((rid, seq, dict(cmd)))
+            r.writes_accepted += 1
+        else:
+            r.writes_rejected += 1  # I2: soft-dead 502
+
+    def _running(self) -> List[Daemon]:
+        return [d for d in self.daemons if d.running]
+
+    def _pull(self) -> None:
+        up = self._running()
+        if not up:
+            return
+        d = self.rng.choice(up)
+        peer = self.rng.choice(d.peer_urls)
+        code, body = _http(d.url + "/admin/pull", "POST", {"peer": peer})
+        assert code == 200, f"I4: pull 500d: {body!r}"  # chain rule etc.
+        self.report.pulls += json.loads(body)["pulled"]
+
+    def _barrier(self) -> None:
+        d = self.daemons[0]  # the fleet's single coordinator
+        if not d.running:
+            return
+        code, body = _http(d.url + "/admin/barrier", "POST", {})
+        assert code == 200, f"I4: barrier 500d: {body!r}"
+        if json.loads(body)["frontier"]:
+            self.report.barriers += 1
+        else:
+            self.report.barriers_empty += 1
+
+    def _checkpoint(self) -> None:
+        up = self._running()
+        if not up:
+            return
+        d = self.rng.choice(up)
+        code, body = _http(d.url + "/admin/checkpoint", "POST", {})
+        assert code == 200, f"I4: checkpoint failed: {body!r}"
+        # durability bar: everything this boot accepted so far must
+        # survive any later crash of this incarnation
+        rid = d.wire_rid
+        self.ckpt_watermark[rid] = self.accepted_per_boot.get(rid, 0)
+        self.report.checkpoints += 1
+
+    def _soft_toggle(self) -> None:
+        up = self._running()
+        if not up:
+            return
+        d = self.rng.choice(up)
+        code, _ = _http(d.url + "/ping")
+        alive = code == 200
+        _http(d.url + f"/condition/{str(not alive).lower()}")
+        if alive:
+            self.report.soft_kills += 1
+        else:
+            self.report.soft_revives += 1
+
+    def _sigkill(self) -> None:
+        running = [d for d in self.daemons if d.running]
+        if len(running) <= 1:
+            return  # keep at least one survivor holding the gossip history
+        self.rng.choice(running).sigkill()
+        self.report.sigkills += 1
+
+    def _restore(self) -> None:
+        dead = [d for d in self.daemons if not d.running]
+        if not dead:
+            return
+        self.rng.choice(dead).spawn()
+        self.report.restores += 1
+
+    def step(self) -> None:
+        x = self.rng.random()
+        if x < 0.40:
+            self._write()
+        elif x < 0.65:
+            self._pull()
+        elif x < 0.75:
+            self._barrier()
+        elif x < 0.85:
+            self._checkpoint()
+        elif x < 0.88:
+            self._soft_toggle()
+        elif x < 0.93:
+            self._sigkill()
+        else:
+            self._restore()
+        self.report.steps += 1
+
+    # ---- heal + invariants ----
+
+    def _states(self) -> List[Optional[Dict[str, str]]]:
+        out = []
+        for d in self.daemons:
+            code, body = _http(d.url + "/data")
+            out.append(json.loads(body) if code == 200 else None)
+        return out
+
+    def heal_and_check(self, max_rounds: int = 60) -> CrashReport:
+        r = self.report
+        for d in self.daemons:
+            if not d.running:
+                d.spawn()
+                r.restores += 1
+            _http(d.url + "/condition/true")  # clear soft faults
+        rounds = 0
+        while True:
+            states = self._states()
+            # convergence = equal STATES and equal VERSION VECTORS: two
+            # states can agree by luck while an undelivered delta-0 op is
+            # still missing somewhere — vv equality closes that hole
+            vvs = []
+            for d in self.daemons:
+                code, body = _http(d.url + "/vv")
+                vvs.append(json.loads(body)["vv"] if code == 200 else None)
+            if (
+                all(s is not None for s in states)
+                and all(s == states[0] for s in states[1:])
+                and all(v == vvs[0] for v in vvs)
+            ):
+                break
+            assert rounds < max_rounds, f"liveness violated (I3): {states}"
+            for d in self.daemons:
+                for peer in d.peer_urls:
+                    code, body = _http(d.url + "/admin/pull", "POST",
+                                       {"peer": peer})
+                    assert code == 200, f"I4: heal pull 500d: {body!r}"
+            rounds += 1
+        r.rounds_to_converge = rounds
+
+        # the fleet's surviving per-writer prefix
+        code, body = _http(self.daemons[0].url + "/vv")
+        assert code == 200
+        vv = {int(k): int(v) for k, v in json.loads(body)["vv"].items()}
+
+        # I1a: explicitly checkpointed writes survived every crash
+        for rid, bar in self.ckpt_watermark.items():
+            assert vv.get(rid, -1) >= bar - 1, (
+                f"checkpointed writes lost: writer {rid} checkpointed "
+                f"{bar} writes but fleet holds only {vv.get(rid, -1) + 1}"
+            )
+        # I1b: writers whose process was never killed after those writes
+        # lost nothing — the CURRENT boot of every slot is alive now
+        for d in self.daemons:
+            rid = d.wire_rid
+            n = self.accepted_per_boot.get(rid, 0)
+            assert vv.get(rid, -1) == n - 1, (
+                f"live writer {rid} accepted {n} writes, fleet holds "
+                f"{vv.get(rid, -1) + 1}"
+            )
+
+        # I1c: converged state == fold of exactly the surviving prefix
+        sums: Dict[str, int] = {}
+        survived = 0
+        for rid, seq, cmd in self.ops:
+            if seq <= vv.get(rid, -1):
+                survived += 1
+                for k, v in cmd.items():
+                    sums[k] = sums.get(k, 0) + int(v)
+        r.ops_lost_to_crashes = len(self.ops) - survived
+        want = {k: str(v) for k, v in sums.items()}
+        got = self._states()[0]
+        assert got == want, (
+            f"durability violated (I1): fold of surviving ops has "
+            f"{len(want)} keys, cluster has {len(got)}; diff="
+            f"{ {k: (want.get(k), got.get(k)) for k in set(want) | set(got) if want.get(k) != got.get(k)} }"
+        )
+        r.final_keys = len(got)
+        return r
+
+    def close(self) -> None:
+        for d in self.daemons:
+            d.shutdown()
+        if self._tmp is not None:
+            self._tmp.cleanup()
+
+    def run(self, n_steps: int) -> CrashReport:
+        try:
+            for _ in range(n_steps):
+                self.step()
+            return self.heal_and_check()
+        finally:
+            self.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="crash-recovery soak")
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--seeds", type=int, default=2)
+    ap.add_argument("--replicas", type=int, default=3)
+    args = ap.parse_args(argv)
+    for seed in range(args.seeds):
+        runner = CrashSoakRunner(n=args.replicas, seed=seed)
+        print(f"seed {seed}: {runner.run(args.steps)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,7 @@ which case callers use the pure-Python Interner/encode path
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import pathlib
 import subprocess
 from typing import Optional
@@ -16,21 +17,53 @@ import numpy as np
 
 _DIR = pathlib.Path(__file__).resolve().parent
 _SO = _DIR / "libcrdt_ingest.so"
+_HASH_MAGIC = b"CRDT_SRC_HASH:"
 
 AVAILABLE = False
 _lib: Optional[ctypes.CDLL] = None
 
 
-def _build() -> bool:
+def _src_hash() -> str:
+    """The same stamp the Makefile computes: sha256 of ingest.cpp ++
+    Makefile, first 16 hex chars."""
+    h = hashlib.sha256()
+    h.update((_DIR / "ingest.cpp").read_bytes())
+    h.update((_DIR / "Makefile").read_bytes())
+    return h.hexdigest()[:16]
+
+
+def _embedded_hash() -> Optional[str]:
+    """The stamp baked into the binary (scanned from the file bytes — no
+    dlopen, so a stale library is never mapped into the process)."""
     try:
-        src_mtime = max(
-            (_DIR / "ingest.cpp").stat().st_mtime,
-            (_DIR / "Makefile").stat().st_mtime,
-        )
-        if _SO.exists() and _SO.stat().st_mtime >= src_mtime:
-            return True  # fresh: skip the make fork on every import
+        data = _SO.read_bytes()
+    except OSError:
+        return None
+    i = data.find(_HASH_MAGIC)
+    if i < 0:
+        return None  # pre-stamp binary: always rebuild
+    tail = data[i + len(_HASH_MAGIC):i + len(_HASH_MAGIC) + 16]
+    return tail.decode("ascii", errors="replace")
+
+
+def _build() -> bool:
+    """Ensure the .so matches the current sources, by content hash: the
+    binary is not committed to git, and a checked-out stale binary must
+    never load silently (ADVICE.md round 1), so freshness is the embedded
+    source stamp.  A freshly-made binary is trusted even when the stamp
+    cannot be verified (e.g. sha256sum absent makes the Makefile stamp
+    empty): make just built it from the current sources, and accepting it
+    avoids re-forking the compiler on every import forever."""
+    try:
+        want = _src_hash()
+        if _SO.exists() and _embedded_hash() == want:
+            return True  # verified fresh: skip the make fork
+        # mismatch or missing: rebuild.  No -B needed — local build
+        # artifacts have truthful mtimes (only committed binaries lied,
+        # and those are gone), so make no-ops when already fresh.
         subprocess.run(
-            ["make", "-C", str(_DIR), "-s"], check=True, capture_output=True
+            ["make", "-C", str(_DIR), "-s"],
+            check=True, capture_output=True,
         )
         return _SO.exists()
     except Exception:
@@ -39,17 +72,14 @@ def _build() -> bool:
 
 def _load() -> Optional[ctypes.CDLL]:
     global AVAILABLE
-    # rebuild when ingest.cpp is newer than a previously-committed .so
-    # (stale-binary hazard); _build stats mtimes and skips the make fork
-    # when fresh
-    _build()
-    if not _SO.exists():
+    if not _build():
+        # no toolchain / build failed / stamp mismatch: never load a
+        # possibly-stale binary — fall back to the pure-Python path
         return None
     try:
         lib = _bind(ctypes.CDLL(str(_SO)))
     except (OSError, AttributeError):
-        # stale .so missing newer symbols on a machine where make failed:
-        # fall back cleanly to the pure-Python path (module contract)
+        # loadable but missing symbols (half-written build?): fall back
         return None
     AVAILABLE = True
     return lib

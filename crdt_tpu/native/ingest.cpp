@@ -313,4 +313,19 @@ const char* crdt_wire_payload(void* p, void* keys_interner,
   return out.data();
 }
 
+// Source-hash stamp: the Makefile passes -DCRDT_SRC_HASH=<sha256 prefix of
+// ingest.cpp+Makefile>; the loader (crdt_tpu/native/__init__.py) scans the
+// .so bytes for the "CRDT_SRC_HASH:" magic and rebuilds on mismatch — a
+// stale binary can never be used silently (mtimes are untrustworthy on a
+// fresh checkout, where every file carries the same timestamp).
+#ifndef CRDT_SRC_HASH
+#define CRDT_SRC_HASH "unknown"
+#endif
+#define CRDT_STR2(x) #x
+#define CRDT_STR(x) CRDT_STR2(x)
+const char* crdt_source_hash(void) {
+  static const char kHash[] = "CRDT_SRC_HASH:" CRDT_STR(CRDT_SRC_HASH);
+  return kHash;
+}
+
 }  // extern "C"

@@ -136,7 +136,7 @@ def _shift_down(x, s, fill):
     )
 
 
-def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref):
+def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref, nu_ref):
     """FUSED columnar union: bitonic merge + adjacent-dup OR-combine +
     log-step hole compaction, entirely in VMEM — one HBM round trip for the
     whole union (the unfused path pays a second full sort through XLA just
@@ -152,9 +152,16 @@ def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref):
          remaining displacement has bit b set moves up by 2^b.  Sorted
          order makes displacements monotone per column, so take/keep never
          collide (validated against a host oracle in tests).
+
+    ``ko_ref``/``vo_ref`` may be SHORTER than 2C rows (static out_size
+    truncation): only their row count is written back to HBM — a
+    capacity-bounded union (OpLog/OR-Set merge at fixed capacity C) then
+    moves half the output bytes.  ``nu_ref`` (1, L) gets the TRUE unique
+    count per lane, computed pre-truncation, so overflow stays detectable.
     """
     c = ka_ref.shape[0]
     n = 2 * c
+    out_rows = ko_ref.shape[0]
     keys = jnp.concatenate([ka_ref[:], kbr_ref[:]], axis=0)
     vals = jnp.concatenate([va_ref[:], vbr_ref[:]], axis=0)
     keys, vals = _merge_stages(keys, vals, n)
@@ -182,6 +189,10 @@ def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref):
         s *= 2
     disp = jnp.where(hole, 0, p - hole.astype(jnp.int32))
 
+    # true unique count per lane (pre-truncation): 2C minus holes; p's last
+    # row is the inclusive prefix sum = the column's total hole count
+    nu_ref[:] = n - p[n - 1:n]
+
     # log-step compaction (monotone displacements: no collisions)
     s = 1
     while s < n:
@@ -195,8 +206,8 @@ def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref):
         disp = jnp.where(take, cand_d - s, jnp.where(keep, disp, 0))
         s *= 2
 
-    ko_ref[:] = keys
-    vo_ref[:] = vals
+    ko_ref[:] = keys[:out_rows]
+    vo_ref[:] = vals[:out_rows]
 
 
 @partial(jax.jit, static_argnames=("out_size", "interpret"))
@@ -210,30 +221,38 @@ def sorted_union_columnar_fused(
 ):
     """Fused-kernel batched sorted-set union (see _union_kernel): same
     contract as sorted_union_columnar, values OR-combined on duplicates.
-    Returns (keys[out, L], vals[out, L], n_unique[L])."""
+    Returns (keys[out, L], vals[out, L], n_unique[L]).
+
+    ``out_size`` is applied INSIDE the kernel (static output block shape):
+    a capacity-bounded union (out_size == C) writes half the output bytes
+    of the naive (2C, L) result — the dominant HBM saving for the OpLog /
+    OR-Set merge-at-capacity path.  n_unique is the pre-truncation unique
+    count, so callers still detect overflow (n_unique > out_size)."""
     c, lanes = keys_a.shape
     assert c & (c - 1) == 0, f"capacity {c} must be a power of two"
     assert lanes % LANES == 0, f"lane count {lanes} must be a multiple of {LANES}"
+    out = out_size if out_size is not None else 2 * c
+    assert out <= 2 * c, f"out_size {out} exceeds the 2C={2*c} union bound"
     grid = (lanes // LANES,)
     in_spec = pl.BlockSpec((c, LANES), lambda i: (0, i))
-    out_spec = pl.BlockSpec((2 * c, LANES), lambda i: (0, i))
-    ko, vo = pl.pallas_call(
+    out_spec = pl.BlockSpec((out, LANES), lambda i: (0, i))
+    nu_spec = pl.BlockSpec((1, LANES), lambda i: (0, i))
+    ko, vo, nu = pl.pallas_call(
         _union_kernel,
         grid=grid,
         in_specs=[in_spec] * 4,
-        out_specs=[out_spec] * 2,
+        out_specs=[out_spec, out_spec, nu_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((2 * c, lanes), keys_a.dtype),
-            jax.ShapeDtypeStruct((2 * c, lanes), vals_a.dtype),
+            jax.ShapeDtypeStruct((out, lanes), keys_a.dtype),
+            jax.ShapeDtypeStruct((out, lanes), vals_a.dtype),
+            jax.ShapeDtypeStruct((1, lanes), jnp.int32),
         ],
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=96 * 1024 * 1024,
         ),
     )(keys_a, vals_a, jnp.flip(keys_b, axis=0), jnp.flip(vals_b, axis=0))
-    n_unique = jnp.sum(ko != SENTINEL, axis=0).astype(jnp.int32)
-    out = out_size if out_size is not None else 2 * c
-    return ko[:out], vo[:out], n_unique
+    return ko, vo, nu[0]
 
 
 def _dedupe_and_compact(keys, vals, combine, out_size):

@@ -23,8 +23,57 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
-from crdt_tpu.oracle.replica import OracleReplica, Quirks
+from crdt_tpu.oracle.replica import HandlerResult, OracleReplica, Quirks
 from crdt_tpu.utils.clock import HostClock
+
+
+def _go_json_str(s: str) -> str:
+    """One string, escaped exactly as Go's encoding/json encodeString
+    does (with the default HTML escaping gin uses): only \\, \", \\n, \\r,
+    \\t get short escapes; other control chars become \\u00xx (so \\b is
+    \\u0008, NOT Python's \\b); <, >, & become \\u003c/e/26; everything
+    else — including non-ASCII — is raw UTF-8."""
+    out = ['"']
+    for ch in s:
+        if ch in ('"', "\\"):
+            out.append("\\" + ch)
+        elif ch == "\n":
+            out.append("\\n")
+        elif ch == "\r":
+            out.append("\\r")
+        elif ch == "\t":
+            out.append("\\t")
+        elif ch < "\x20":
+            out.append(f"\\u{ord(ch):04x}")
+        elif ch in "<>&":
+            out.append(f"\\u{ord(ch):04x}")
+        elif ch in ("\u2028", "\u2029"):  # encoding/json escapes these too
+            out.append(f"\\u{ord(ch):04x}")
+        else:
+            out.append(ch)
+    out.append('"')
+    return "".join(out)
+
+
+def go_json_dumps(obj) -> str:
+    """encoding/json-compatible marshal of (possibly nested) string maps:
+    keys sorted lexicographically (Go sorts map keys in Marshal; the
+    treemap's ToJSON at main.go:159 goes through map[string]interface{},
+    so gossip key order is STRING order — equal to numeric order for the
+    13-digit same-epoch ms keys, but not in general), no whitespace, raw
+    UTF-8, and encodeString's exact escaping (see _go_json_str).  Handles
+    the shim's value shapes: str, None (a nil *Command marshals as null),
+    and nested string maps."""
+    if obj is None:
+        return "null"
+    if isinstance(obj, str):
+        return _go_json_str(obj)
+    if isinstance(obj, dict):
+        return "{" + ",".join(
+            f"{_go_json_str(str(k))}:{go_json_dumps(v)}"
+            for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))
+        ) + "}"
+    raise TypeError(f"go_json_dumps: unsupported type {type(obj)!r}")
 
 
 class OracleNode:
@@ -39,12 +88,14 @@ class OracleNode:
     def alive(self) -> bool:
         return self.oracle.alive
 
-    def add_command(self, cmd) -> bool:
+    def add_command(self, cmd) -> HandlerResult:
+        """AddCommand under the lock (main.go:175); cmd=None is an
+        unparseable body (the no-return 500 path, quirk §0.1.11)."""
         with self._lock:
-            if not self.oracle.alive:
-                return False
-            self.oracle.add_command(dict(cmd), ts=self.clock.now_ms())
-            return True
+            return self.oracle.add_command(
+                dict(cmd) if cmd is not None else None,
+                ts=self.clock.now_ms(),
+            )
 
     def get_state(self):
         # GetState reads CurrentState without the lock (quirk §0.1.6);
@@ -57,21 +108,28 @@ class OracleNode:
         with self._lock:  # Gossip takes the lock (main.go:156)
             if not self.oracle.alive:
                 return None
-            return json.dumps(
+            return go_json_dumps(
                 # log entries are (command, is_local): the pointer/value
                 # distinction does not survive serialization (main.go:159),
-                # which is exactly what makes quirk 0.1.1 asymmetric
-                {str(k[0]): dict(entry[0])
+                # which is exactly what makes quirk 0.1.1 asymmetric; a nil
+                # command (invalid-body Put, main.go:187) marshals as null
+                {str(k[0]): entry[0]
                  for k, entry in sorted(self.oracle.log.items())}
             )
 
     def receive_wire(self, body: str) -> None:
         """The gossip goroutine's unmarshal + merge (main.go:241-257)."""
         remote = {
-            (int(ts),): dict(cmd) for ts, cmd in json.loads(body).items()
+            (int(ts),): (dict(cmd) if cmd is not None else None)
+            for ts, cmd in json.loads(body).items()
         }
         with self._lock:
             self.oracle.merge(remote)
+
+
+TEXT_PLAIN = "text/plain; charset=utf-8"     # gin c.String's content type
+APP_JSON_CHARSET = "application/json; charset=utf-8"  # gin c.JSON's
+APP_JSON = "application/json"  # Gossip sets the header by hand (main.go:163)
 
 
 def _make_handler(node: OracleNode):
@@ -79,9 +137,10 @@ def _make_handler(node: OracleNode):
         def log_message(self, *a):
             pass
 
-        def _send(self, code, body):
+        def _send(self, code, body, ctype=TEXT_PLAIN):
             data = body.encode()
             self.send_response(code)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -90,28 +149,31 @@ def _make_handler(node: OracleNode):
             path = self.path.split("?")[0]
             if path == "/ping":
                 if node.alive:
-                    self._send(200, "Pong")
+                    self._send(200, "Pong")  # main.go:120
                 else:
-                    self._send(502, "Unreachable")  # main.go:119-126
+                    self._send(502, "Unreachable")  # main.go:123
             elif path == "/data":
                 state = node.get_state()
                 if state is None:
-                    self._send(502, "Unreachable")
+                    self._send(502, "Unreachable")  # main.go:135
                 else:
-                    self._send(200, json.dumps(state))
+                    # c.JSON of map[string]string: sorted keys, HTML-escaped
+                    self._send(200, go_json_dumps(state), APP_JSON_CHARSET)
             elif path == "/gossip":
                 wire = node.gossip_wire()
                 if wire is None:
-                    self._send(502, "Unreachable")
+                    self._send(502, "Unreachable")  # main.go:167
                 else:
-                    self._send(200, wire)
+                    self._send(200, wire, APP_JSON)  # main.go:163-164
             elif path == "/condition":
                 # the reference registered the route WITHOUT the parameter
-                # binding, so ParseBool("") always errors -> 500 (§0.1.7);
-                # byte-faithful breakage
-                self._send(500, "Unable to process request")
+                # binding (main.go:266 vs main.go:145), so the handler runs
+                # ParseBool("") and 500s with its exact error (main.go:147)
+                self._send(
+                    500, 'strconv.ParseBool: parsing "": invalid syntax'
+                )
             else:
-                self._send(404, "404 page not found")
+                self._send(404, "404 page not found")  # gin's default 404
 
         def do_POST(self):
             if self.path.split("?")[0] != "/data":
@@ -123,12 +185,14 @@ def _make_handler(node: OracleNode):
                 assert isinstance(cmd, dict)
                 cmd = {str(k): str(v) for k, v in cmd.items()}
             except Exception:
-                self._send(500, "Request body is invalid")
-                return
-            if node.add_command(cmd):
-                self._send(200, "Inserted")
-            else:
-                self._send(502, "Unreachable")
+                # unparseable body: the handler 500s but does NOT return
+                # (main.go:183-186, quirk §0.1.11) — the nil command is
+                # still Put into the log and "Inserted" is appended to the
+                # 500 body (main.go:187, main.go:208).  OracleNode models
+                # this as add_command(None).
+                cmd = None
+            res = node.add_command(cmd)
+            self._send(res.status, res.body)
 
     return Handler
 

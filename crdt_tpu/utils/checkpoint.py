@@ -5,10 +5,28 @@ SURVEY.md §5).  Both recovery paths exist here:
 * gossip catch-up (free: one full-state join, crdt_tpu.parallel.swarm);
 * durable snapshots of the array state + host interner tables, via orbax
   when available and a numpy .npz fallback otherwise.
+
+Crash-safety layer (round 2): `save_node_atomic` / `load_latest_node`
+write versioned snapshot directories with an atomically-replaced LATEST
+pointer, so a SIGKILL mid-save can never corrupt the restore source; and
+`bump_incarnation` implements the boot-incarnation rule that makes
+restores safe in a LIVE fleet:
+
+    A killed daemon may have minted ops after its last snapshot and
+    gossiped them to peers before dying.  If the restored process reused
+    its old writer id, its seq counter (restored from the snapshot) would
+    re-mint (rid, seq) identities that already exist on peers with
+    different timestamps — corrupting version-vector dedup and delta
+    slicing, which assume (rid, seq) uniquely names one op.  So every
+    boot claims a fresh incarnation k (persisted BEFORE serving: a crash
+    between bump and first write just burns a number) and writes as
+    wire rid = base_rid + stride*k.  The previous incarnation's ops are
+    then a frozen writer prefix that flows back via ordinary gossip.
 """
 from __future__ import annotations
 
 import json
+import os
 import pathlib
 from typing import Any, Optional
 
@@ -52,14 +70,23 @@ def save_node(path: str, node) -> None:
     (p / "meta.json").write_text(json.dumps(meta))
 
 
-def restore_node(path: str, node) -> None:
-    """Restore a snapshot into a freshly-constructed ReplicaNode."""
+def restore_node(path: str, node, allow_rid_change: bool = False) -> None:
+    """Restore a snapshot into a freshly-constructed ReplicaNode.
+
+    ``allow_rid_change=True`` is the boot-incarnation path (see module
+    docstring): the restoring node carries a FRESH wire rid, adopts the
+    snapshot's log/commands/frontier wholesale (the old rid's ops become a
+    frozen foreign-writer prefix), and keeps its own zero-based seq
+    counter — the snapshot's counter belongs to the dead incarnation.
+    """
     from crdt_tpu.models import oplog as oplog_mod
     import jax.numpy as jnp
 
     p = pathlib.Path(path)
     meta = json.loads((p / "meta.json").read_text())
-    assert meta["rid"] == node.rid, "snapshot belongs to another replica"
+    rid_changed = meta["rid"] != node.rid
+    if rid_changed and not allow_rid_change:
+        raise AssertionError("snapshot belongs to another replica")
     _interner_load(meta["keys"], node.keys)
     _interner_load(meta["values"], node.values)
     with np.load(p / "log.npz") as z:
@@ -70,7 +97,8 @@ def restore_node(path: str, node) -> None:
             is_num=jnp.asarray(z["is_num"]),
         )
     node.alive = meta["alive"]
-    node._seq.count = meta["seq"]
+    if not rid_changed:
+        node._seq.count = meta["seq"]
     node.clock.epoch_ms = meta["epoch_ms"]
     node._commands = {
         (c["ts"], c["rid"], c["seq"]): c["cmd"] for c in meta["commands"]
@@ -78,6 +106,81 @@ def restore_node(path: str, node) -> None:
     node._frontier = {int(r): int(s) for r, s in meta.get("frontier", [])}
     node._summary = meta.get("summary", {})
     node._rebuild_indexes_locked()  # delta indexes + summary-cache invalidation
+
+
+# ---- crash-safe versioned snapshots + boot incarnations ---------------------
+
+
+def _replace_file(path: pathlib.Path, data: str) -> None:
+    """Atomic file write: tmp sibling + fsync + os.replace."""
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def save_node_atomic(root: str, node) -> str:
+    """Snapshot ``node`` into a fresh versioned directory under ``root``
+    and atomically repoint LATEST at it — a SIGKILL at ANY instant leaves
+    either the previous complete snapshot or the new complete snapshot as
+    the restore source, never a torn one.  Holds the node's lock for a
+    consistent cut.  Keeps the last two snapshots.  Returns the dir.
+
+    The snapshot number comes from scanning existing snap dirs, NOT from
+    LATEST: a kill between the rename and the LATEST repoint leaves an
+    orphan snap dir ahead of LATEST, and deriving n from LATEST would then
+    collide with it (os.rename onto a non-empty dir raises) — killing
+    every future checkpoint."""
+    import shutil
+
+    rootp = pathlib.Path(root)
+    rootp.mkdir(parents=True, exist_ok=True)
+    latest = rootp / "LATEST"
+    snaps = sorted(rootp.glob("snap-*"))
+    n = int(snaps[-1].name.rsplit("-", 1)[-1]) + 1 if snaps else 0
+    staging = rootp / f".staging-{os.getpid()}-{n}"
+    shutil.rmtree(staging, ignore_errors=True)  # orphan from a past crash
+    with node._lock:
+        save_node(str(staging), node)
+    final = rootp / f"snap-{n:08d}"
+    os.rename(staging, final)  # same fs: atomic
+    _replace_file(latest, final.name)
+    # keep the newest two snaps; also sweep crashed staging orphans
+    for old in sorted(rootp.glob("snap-*"))[:-2]:
+        shutil.rmtree(old, ignore_errors=True)
+    for orphan in rootp.glob(".staging-*"):
+        if orphan != staging:
+            shutil.rmtree(orphan, ignore_errors=True)
+    return str(final)
+
+
+def load_latest_node(root: str, node, allow_rid_change: bool = True) -> bool:
+    """Restore the newest complete snapshot under ``root`` into ``node``;
+    False when none exists (fresh boot)."""
+    rootp = pathlib.Path(root)
+    latest = rootp / "LATEST"
+    if not latest.exists():
+        return False
+    snap = rootp / latest.read_text().strip()
+    restore_node(str(snap), node, allow_rid_change=allow_rid_change)
+    return True
+
+
+def bump_incarnation(root: str) -> int:
+    """Claim this boot's incarnation number: read boot.json, persist the
+    NEXT number (fsync'd) before returning, so no two boots of the same
+    checkpoint dir ever share an incarnation — the (rid, seq)-uniqueness
+    keystone for restores into a live fleet (module docstring)."""
+    rootp = pathlib.Path(root)
+    rootp.mkdir(parents=True, exist_ok=True)
+    boot = rootp / "boot.json"
+    k = 0
+    if boot.exists():
+        k = int(json.loads(boot.read_text())["incarnation"])
+    _replace_file(boot, json.dumps({"incarnation": k + 1}))
+    return k
 
 
 def save_swarm(path: str, state: Any) -> None:

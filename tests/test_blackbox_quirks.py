@@ -44,8 +44,10 @@ def test_surface_matches_reference(quirky):
     assert _req(u + "/condition")[0] == 500
     assert _req(u + "/condition?alive_status=false")[0] == 500
     assert _req(u + "/nope")[0] == 404
+    # invalid body: 500 written WITHOUT return, nil command logged, then
+    # "Inserted" appended to the same response (main.go:183-187, 208)
     code, body = _req(u + "/data", "POST", b"not json")
-    assert (code, body) == (500, b"Request body is invalid")  # main.go:179-186
+    assert (code, body) == (500, b"Request body is invalidInserted")
     code, body = _req(u + "/data", "POST", json.dumps({"x": "5"}).encode())
     assert (code, body) == (200, b"Inserted")  # main.go:208
 

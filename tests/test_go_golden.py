@@ -1,0 +1,278 @@
+"""Golden fixtures for the quirk-compat shim, derived from a line-by-line
+read of /root/reference/main.go — NOT from the oracle the shim wraps.
+
+Round-1 verdict: black-box parity ran only against crdt_tpu.oracle.shim,
+whose fidelity was asserted by the same codebase being tested; a misread of
+a Go behavior would be invisible (oracle and shim agreeing with each other).
+These fixtures pin the shim to the *source*: every expected byte cites the
+main.go line that produces it, so a fidelity bug must now contradict a
+literal reading of the reference.
+
+Go serialization facts encoded here (all checkable against the stdlib docs
+plus the cited lines — no Go toolchain in this image):
+
+* gin ``c.String`` writes ``text/plain; charset=utf-8`` and the exact
+  format string; ``err.Error()`` for strconv failures renders as
+  ``strconv.<Fn>: parsing "<in>": invalid syntax`` (strconv.NumError).
+* gin ``c.JSON`` (GetState, main.go:132) uses encoding/json WITH HTML
+  escaping: map keys sorted lexicographically, no whitespace, and
+  ``<``/``>``/``&`` escaped as ``\\u003c``/``\\u003e``/``\\u0026``.
+* ``Diff.ToJSON()`` (Gossip, main.go:159) goes through gods' treemap
+  ToJSON, which builds a ``map[string]interface{}`` and json.Marshals it —
+  so gossip keys are ordered as STRINGS, not numbers (fixture below pins
+  the "1000" < "999" case), and a nil ``*Command`` (the invalid-body Put,
+  main.go:187) marshals as ``null``.
+"""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from crdt_tpu.oracle.shim import OracleHttpCluster
+from crdt_tpu.utils.clock import ManualClock
+
+
+def _req(url, method="GET", data=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as res:
+            return res.status, res.read(), res.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type")
+
+
+@pytest.fixture
+def shim():
+    c = OracleHttpCluster(n=1, clock=ManualClock(start=1_000_000))
+    c.start()
+    yield c
+    c.stop()
+
+
+TEXT = "text/plain; charset=utf-8"
+
+# (name, setup writes [(ms-advance, body-bytes)], request (method, path,
+#  body), want (status, body, content-type), main.go citation)
+FIXTURES = [
+    (
+        "ping_alive",
+        [],
+        ("GET", "/ping", None),
+        (200, b"Pong", TEXT),
+        "main.go:120 c.String(200, \"Pong\")",
+    ),
+    (
+        "get_state_empty",
+        [],
+        ("GET", "/data", None),
+        (200, b"{}", "application/json; charset=utf-8"),
+        "main.go:132 c.JSON(200, CurrentState) on the empty initial state "
+        "(main.go:218)",
+    ),
+    (
+        "condition_no_param",
+        [],
+        ("GET", "/condition", None),
+        (500, b'strconv.ParseBool: parsing "": invalid syntax', TEXT),
+        "main.go:266 registers /condition WITHOUT :alive_status, so "
+        "c.Param() is \"\" and ParseBool errors (main.go:145-148)",
+    ),
+    (
+        "condition_query_param_still_broken",
+        [],
+        ("GET", "/condition?alive_status=false", None),
+        (500, b'strconv.ParseBool: parsing "": invalid syntax', TEXT),
+        "main.go:145 reads a PATH param; query strings never bind it",
+    ),
+    (
+        "unknown_route_404",
+        [],
+        ("GET", "/nope", None),
+        (404, b"404 page not found", TEXT),
+        "gin's default NoRoute body (no custom handler registered, "
+        "main.go:262-266)",
+    ),
+    (
+        "post_new_key_early_return",
+        [],
+        ("POST", "/data", b'{"x":"5"}'),
+        (200, b"Inserted", TEXT),
+        "main.go:191-193: unseen key -> set verbatim, 200 \"Inserted\"",
+    ),
+    (
+        "post_invalid_body_double_write",
+        [],
+        ("POST", "/data", b"not json"),
+        (500, b"Request body is invalidInserted", TEXT),
+        "main.go:184-186 writes the 500 WITHOUT return (quirk 0.1.11); "
+        "main.go:187 still Puts the nil command; the nil-map range loop "
+        "(main.go:188) is a no-op; main.go:208 appends \"Inserted\" to the "
+        "already-written response",
+    ),
+    (
+        "post_current_value_not_numeric",
+        [(0, b'{"k":"abc"}')],
+        ("POST", "/data", b'{"k":"5"}'),
+        (500, b'strconv.Atoi: parsing "abc": invalid syntax', TEXT),
+        "main.go:195-198: Atoi(CurrentState[k]) fails -> "
+        "c.String(500, err.Error())",
+    ),
+    (
+        "post_delta_not_numeric",
+        [(0, b'{"n":"5"}')],
+        ("POST", "/data", b'{"n":"x"}'),
+        (500, b'strconv.Atoi: parsing "x": invalid syntax', TEXT),
+        "main.go:200-203: Atoi(value) fails -> c.String(500, err.Error())",
+    ),
+    (
+        "post_delta_out_of_int64_range",
+        [(0, b'{"n":"5"}')],
+        ("POST", "/data", b'{"n":"99999999999999999999"}'),
+        (
+            500,
+            b'strconv.Atoi: parsing "99999999999999999999": value out of '
+            b"range",
+            TEXT,
+        ),
+        "main.go:200-203 with strconv's ErrRange: Go ints are 64-bit; "
+        "Python's are not, so the oracle bounds-checks explicitly",
+    ),
+    (
+        "get_state_backspace_escaping",
+        [(0, b'{"s":"a\\bb"}')],
+        ("GET", "/data", None),
+        (
+            200,
+            b'{"s":"a\\u0008b"}',
+            "application/json; charset=utf-8",
+        ),
+        "encoding/json gives only \\n \\r \\t short escapes; \\b must be "
+        "\\u0008 (Python's json.dumps would emit \\b)",
+    ),
+    (
+        "post_numeric_sum",
+        [(0, b'{"n":"5"}'), (10, b'{"n":"-3"}')],
+        ("GET", "/data", None),
+        (200, b'{"n":"2"}', "application/json; charset=utf-8"),
+        "main.go:195-206: both parse -> Itoa(curr+change) (eager fold)",
+    ),
+    (
+        "get_state_sorted_keys",
+        [(0, b'{"b":"1"}'), (10, b'{"a":"2"}')],
+        ("GET", "/data", None),
+        (200, b'{"a":"2","b":"1"}', "application/json; charset=utf-8"),
+        "encoding/json sorts map keys lexicographically (c.JSON, "
+        "main.go:132); no whitespace",
+    ),
+    (
+        "get_state_html_escaping",
+        [(0, b'{"s":"a<b&c>d"}')],
+        ("GET", "/data", None),
+        (
+            200,
+            b'{"s":"a\\u003cb\\u0026c\\u003ed"}',
+            "application/json; charset=utf-8",
+        ),
+        "gin c.JSON uses encoding/json's default HTML escaping "
+        "(main.go:132)",
+    ),
+    (
+        "gossip_wire_shape",
+        [(0, b'{"x":"5"}'), (10, b'{"y":"-3"}')],
+        ("GET", "/gossip", None),
+        (
+            200,
+            b'{"1000000":{"x":"5"},"1000010":{"y":"-3"}}',
+            "application/json",
+        ),
+        "main.go:159 Diff.ToJSON() -> full log as {\"<ms>\": {k: v}}; "
+        "main.go:163 sets Content-Type by hand (no charset); "
+        "main.go:164 c.String of the bytes",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "name,setup,request_,want,citation",
+    FIXTURES,
+    ids=[f[0] for f in FIXTURES],
+)
+def test_golden(shim, name, setup, request_, want, citation):
+    u = shim.urls[0]
+    clock = shim.nodes[0].clock
+    for advance_ms, body in setup:
+        clock.advance(advance_ms)
+        _req(u + "/data", "POST", body)
+    method, path, body = request_
+    status, got_body, ctype = _req(u + path, method, body)
+    want_status, want_body, want_ctype = want
+    assert (status, got_body) == (want_status, want_body), citation
+    assert ctype == want_ctype, citation
+
+
+def test_gossip_keys_are_string_ordered():
+    """main.go:159: treemap.ToJSON marshals via map[string]interface{},
+    so the JSON object is ordered by the STRING form of the ms keys —
+    "1000" sorts before "999".  (Irrelevant for same-epoch 13-digit
+    timestamps, where string order == numeric order, but it is what the
+    source does and the shim must match it byte-for-byte.)"""
+    c = OracleHttpCluster(n=1, clock=ManualClock(start=999))
+    c.start()
+    try:
+        u = c.urls[0]
+        _req(u + "/data", "POST", b'{"a":"1"}')   # ts 999
+        c.nodes[0].clock.advance(1)
+        _req(u + "/data", "POST", b'{"b":"2"}')   # ts 1000
+        _, wire, _ = _req(u + "/gossip")
+        assert wire == b'{"1000":{"b":"2"},"999":{"a":"1"}}'
+    finally:
+        c.stop()
+
+
+def test_gossip_null_entry_roundtrip(shim):
+    """The invalid-body Put (main.go:187) leaves a nil *Command in the log;
+    ToJSON marshals it as null (main.go:159).  A peer unmarshals null into
+    a nil map[string]string (main.go:245-246), adopts it (main.go:68), and
+    its rebuild ranges over the nil map as a no-op (main.go:80-81) — so
+    null entries travel the wire forever but never affect state."""
+    u = shim.urls[0]
+    _req(u + "/data", "POST", b"not json")
+    _, wire, _ = _req(u + "/gossip")
+    assert wire == b'{"1000000":null}'
+    # a second shim node adopts the null entry without error, state empty
+    peer = OracleHttpCluster(n=1, clock=ManualClock(start=2_000_000))
+    peer.start()
+    try:
+        pu = peer.urls[0]
+        # peer needs a NEWER local entry for the two-pointer walk to adopt
+        # the older null (tail-drop, main.go:49)
+        _req(pu + "/data", "POST", b'{"z":"9"}')
+        peer.nodes[0].receive_wire(wire.decode())
+        _, state, _ = _req(pu + "/data")
+        # null adopted silently; own z excluded after merge (quirk 0.1.1)
+        assert json.loads(state) == {}
+        _, peer_wire, _ = _req(pu + "/gossip")
+        assert b'"1000000":null' in peer_wire
+    finally:
+        peer.stop()
+
+
+def test_dead_node_502_everywhere(shim):
+    """Alive=false (the merge window, main.go:41, or fault injection as
+    INTENDED by main.go:150): every surface 502s with "Unreachable" —
+    ping main.go:123, GET /data main.go:135, gossip main.go:167, POST
+    /data main.go:211."""
+    shim.nodes[0].oracle.alive = False
+    u = shim.urls[0]
+    for method, path, body in [
+        ("GET", "/ping", None),
+        ("GET", "/data", None),
+        ("GET", "/gossip", None),
+        ("POST", "/data", b'{"x":"1"}'),
+    ]:
+        status, got, ctype = _req(u + path, method, body)
+        assert (status, got) == (502, b"Unreachable"), path
+        assert ctype == TEXT, path

@@ -30,5 +30,5 @@ def test_daemon_mode_boots_and_exits():
         "--daemon", "--rid", "7", "--port", "0", "--duration", "1",
     ])
     assert p.returncode == 0, p.stdout + p.stderr
-    assert "replica rid=7 serving on" in p.stdout
+    assert "replica rid=7 (base 7, incarnation 0, restored=False) serving on" in p.stdout
     assert "final: state_keys=0" in p.stdout
