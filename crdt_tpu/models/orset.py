@@ -114,6 +114,52 @@ def _resort(s: ORSet):
     return out[:3], out[3]
 
 
+# ---- tombstone GC adapter (crdt_tpu.models.tomb_gc) ----
+
+
+class GC_ADAPTER:
+    """Table-layout adapter wiring ORSet into the generic tombstone-GC
+    machinery: wrap a set with ``tomb_gc.wrap(s, n_writers)``, join with
+    ``tomb_gc.join(a, b, orset.GC_ADAPTER)``, reclaim with
+    ``tomb_gc.gc_round``.  Identity = the (rid, seq) add-tag."""
+
+    @staticmethod
+    def key_cols(s: ORSet):
+        return (s.elem, s.rid, s.seq)
+
+    @staticmethod
+    def vals(s: ORSet):
+        return s.removed
+
+    @staticmethod
+    def combine(a, b):
+        return a | b
+
+    @staticmethod
+    def from_union(keys, vals) -> ORSet:
+        return ORSet(elem=keys[0], rid=keys[1], seq=keys[2], removed=vals)
+
+    @staticmethod
+    def rid_seq(s: ORSet):
+        return s.rid, s.seq
+
+    @staticmethod
+    def valid(s: ORSet):
+        return s.elem != SENTINEL
+
+    @staticmethod
+    def capacity_of(s: ORSet) -> int:
+        return s.capacity
+
+    @staticmethod
+    def removed_of(s: ORSet):
+        return s.removed
+
+    @staticmethod
+    def vals_zero_like(s: ORSet, mask):
+        return jnp.where(mask, False, s.removed)
+
+
 # ---- columnar swarm fast path (Pallas bitonic-merge union) ----
 #
 # The canonical high-throughput layout for a *swarm* of OR-Sets puts the

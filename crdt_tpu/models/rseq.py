@@ -1,39 +1,53 @@
 """RSeq: replicated sequence (list) CRDT, array-encoded for TPU.
 
 The reference has no sequence type; a complete CRDT framework ships one (the
-collaborative-editing family: RGA / Logoot / Treedoc).  This design keeps
-the framework's sorted-tensor shape — the state is a sorted, SENTINEL-
-padded fixed-capacity table and the join is a multi-key sorted-segment
-union — by giving every element a flat-sortable **two-level position key**:
+collaborative-editing family: RGA / Logoot / Treedoc / Fugue).  The design
+keeps the framework's sorted-tensor shape — the state is a sorted, SENTINEL-
+padded fixed-capacity table and the join is a multi-key sorted-segment union
+(crdt_tpu.ops.sorted_union, the same engine as the op log,
+/root/reference/main.go:49-73's capability) — by giving every element a
+flat-sortable **variable-depth path key** (round-2 redesign; the round-1
+two-level scheme raised GapExhausted at ~60 nested collisions and had the
+classic Logoot interleaving anomaly):
 
-    level 1:  (pos1, rid1, seq1)   a 60-bit coordinate + an identity
-    level 2:  (pos2, rid2, seq2)
+* An element's identity is a path of up to ``D = depth`` levels, each a
+  ``(pos, rid, seq)`` triple (60-bit virtual coordinate as two int32 words +
+  the writer identity), flattened into a ``4*D``-column sorted key row.
+  Levels beyond an element's *real* depth are STAMPED with
+  ``(MID, own rid, own seq)``; real allocations never use coordinate
+  ``MID``, so lexicographic row order implements the tree order: children
+  (``pos > MID`` under the parent's path prefix) sort directly after their
+  parent and before the parent's next sibling — the RGA insert-after rule.
 
-* A **top-level insert** allocates ``pos1`` between its neighbours'
-  coordinates (appends stride by APPEND_STRIDE so the common case never
-  bisects; interior inserts take the midpoint) and stamps BOTH levels with
-  its own identity, ``pos2 = MID``.
-* When the level-1 gap is exhausted — most commonly because two writers
-  concurrently inserted into the same gap, got the same midpoint, and were
-  tie-broken by (rid, seq) — the insert goes **deep**: it anchors on the
-  LEFT neighbour (level 1 = the neighbour's level-1 triple, copied) and
-  allocates ``pos2 > MID`` between the deep neighbours under that anchor.
-  Lexicographic order then places it after its anchor and before the next
-  level-1 key, which is exactly the RGA insert-after rule.
+* Allocation is RGA-flavoured **left-anchoring** (host-side, like
+  timestamps — never under jit):
+    - continuing my own chain (left neighbour's identity is mine AND its
+      parent level is mine too, i.e. I'm inside my own subtree) extends as
+      a *sibling* at the same depth — ascending stride, O(1) coordinate
+      space per element, depth stays put;
+    - any other insert *descends* under its left neighbour.  Concurrent
+      runs typed into the same gap therefore collide only at their first
+      character and then grow inside identity-protected subtrees — whole
+      runs stay contiguous after the join (no character interleaving; the
+      Fugue/RGA forward-typing guarantee).  Like RGA, concurrent
+      *backward* runs (repeated prepends / fixed-index inserts) may still
+      interleave run-wise.
+    - open-ended gaps stride (``APPEND_STRIDE``) instead of bisecting, so
+      appends, prepends and fixed-index storms cost O(1) gap space each
+      (~2^38 ops per level) rather than halving it.
 
-Concurrent inserts that collide at BOTH levels (same anchor, same pos2
-midpoint) are tie-broken by (rid2, seq2) and remain insertable-around via
-further deep inserts under the same anchor; the only unrepresentable
-pattern is a gap bisected to exhaustion at both levels (~60 nested
-midpoint collisions), which raises ``GapExhausted`` rather than silently
-mis-ordering — identities are immutable in a CRDT, so no rebalancing.
+* When the preferred level's integer gap is exhausted, allocation
+  **re-anchors**: it sweeps every representable level (deepest first) for
+  a gap that keeps the element strictly between its neighbours — order
+  correctness is positional, so any level works; only the interleaving
+  heuristic degrades.  ``GapExhausted`` remains only for a table whose
+  every level was bisected to exhaustion (~58 adversarial collisions *per
+  level*, all ``D`` levels deep).
 
-Everything on-device is the standard machinery: join = 8-key sorted union
-with tombstone-OR (crdt_tpu.ops.sorted_union — the same engine as the op
-log, main.go:49-73's capability); delete = monotone tombstone; read = the
-non-tombstoned payloads in row order (the table IS the list).  Position
-allocation happens host-side at ingestion, like timestamps (never under
-jit)."""
+Everything on-device is the standard machinery: join = 4D-key sorted union
+with tombstone-OR; delete = monotone tombstone; read = the non-tombstoned
+payloads in row order (the table IS the list).
+"""
 from __future__ import annotations
 
 import jax
@@ -45,17 +59,21 @@ from crdt_tpu.utils.constants import SENTINEL
 
 POS_BITS = 60
 POS_MAX = 1 << POS_BITS          # exclusive virtual-coordinate bound
-MID = POS_MAX // 2               # level-2 coordinate of every top insert
+MID = POS_MAX // 2               # reserved stamp coordinate (never allocated)
 HALF_BITS = 30
 HALF_MASK = (1 << HALF_BITS) - 1
-APPEND_STRIDE = 1 << 20          # gap left after an append / before a prepend
-
-KEY_COLS = ("p1_hi", "p1_lo", "rid1", "seq1",
-            "p2_hi", "p2_lo", "rid2", "seq2")
+APPEND_STRIDE = 1 << 20          # gap left by open-ended (chain) allocations
+DEPTH = 6                        # default path depth cap (table width 4*D+2)
 
 
 class GapExhausted(ValueError):
-    """No representable position remains between the two neighbours."""
+    """No representable position remains between the two neighbours at any
+    level — every level's integer gap was bisected to exhaustion."""
+
+
+class CapacityExceeded(ValueError):
+    """The fixed-capacity table has no free row (tombstones count: they
+    occupy slots until compaction/GC reclaims them)."""
 
 
 def split_pos(pos: int):
@@ -67,60 +85,44 @@ def join_pos(hi: int, lo: int) -> int:
     return (int(hi) << HALF_BITS) | int(lo)
 
 
-def _alloc(lo: int, hi: int, *, stride_edges: bool) -> int:
-    """An integer strictly between lo and hi.  With stride_edges, stay
-    APPEND_STRIDE away from an open end so append/prepend runs cost O(1)
-    coordinate space per element instead of halving the gap."""
-    if hi - lo < 2:
-        raise GapExhausted(
-            f"no position left between {lo} and {hi}: nested-midpoint "
-            "collisions exhausted both levels (identities are immutable; "
-            "this needs ~60 adversarial collisions in one gap)"
-        )
-    if stride_edges and hi == POS_MAX and lo != -1 and lo + APPEND_STRIDE < hi:
-        return lo + APPEND_STRIDE           # append: don't bisect the tail
-    if stride_edges and lo == -1 and hi != POS_MAX and hi - APPEND_STRIDE > lo:
-        return hi - APPEND_STRIDE           # prepend: don't bisect the head
-    return (lo + hi) // 2                   # interior (and the first-ever
-    #                                         element: mid-space, so both
-    #                                         ends keep ~2^59 of room)
-
-
 @struct.dataclass
 class RSeq:
-    """Rows sorted by the 8 KEY_COLS; padding rows have every key column =
-    SENTINEL."""
+    """Rows sorted lexicographically by the flattened path-key columns;
+    padding rows have every key column = SENTINEL."""
 
-    p1_hi: jax.Array
-    p1_lo: jax.Array
-    rid1: jax.Array
-    seq1: jax.Array
-    p2_hi: jax.Array
-    p2_lo: jax.Array
-    rid2: jax.Array
-    seq2: jax.Array
-    elem: jax.Array     # int32[C]  payload id (host-interned)
-    removed: jax.Array  # bool[C]   tombstone (monotone)
+    keys: jax.Array     # int32[C, 4*D]  (p_hi, p_lo, rid, seq) x D
+    elem: jax.Array     # int32[C]       payload id (host-interned)
+    removed: jax.Array  # bool[C]        tombstone (monotone)
 
     @property
     def capacity(self) -> int:
-        return self.p1_hi.shape[-1]
+        return self.keys.shape[-2]
+
+    @property
+    def depth(self) -> int:
+        return self.keys.shape[-1] // 4
 
 
-def empty(capacity: int) -> RSeq:
-    s = jnp.full((capacity,), SENTINEL, jnp.int32)
-    return RSeq(**{c: s for c in KEY_COLS},
-                elem=jnp.zeros((capacity,), jnp.int32),
-                removed=jnp.zeros((capacity,), bool))
+def empty(capacity: int, depth: int = DEPTH) -> RSeq:
+    return RSeq(
+        keys=jnp.full((capacity, 4 * depth), SENTINEL, jnp.int32),
+        elem=jnp.zeros((capacity,), jnp.int32),
+        removed=jnp.zeros((capacity,), bool),
+    )
 
 
 def size(s: RSeq) -> jax.Array:
     """Live (non-tombstoned, non-padding) element count."""
-    return jnp.sum((s.p1_hi != SENTINEL) & ~s.removed).astype(jnp.int32)
+    return jnp.sum((s.keys[:, 0] != SENTINEL) & ~s.removed).astype(jnp.int32)
 
 
-def _keys(s: RSeq):
-    return tuple(getattr(s, c) for c in KEY_COLS)
+def n_rows(s: RSeq) -> jax.Array:
+    """Occupied rows (live + tombstoned) — the capacity-pressure metric."""
+    return jnp.sum(s.keys[:, 0] != SENTINEL).astype(jnp.int32)
+
+
+def _key_cols(s: RSeq):
+    return tuple(s.keys[:, i] for i in range(s.keys.shape[-1]))
 
 
 def _vals(s: RSeq):
@@ -133,7 +135,7 @@ def _combine(a, b):
 
 
 def _from_union(keys, vals) -> RSeq:
-    return RSeq(**dict(zip(KEY_COLS, keys)),
+    return RSeq(keys=jnp.stack(keys, axis=-1),
                 elem=vals["elem"], removed=vals["removed"])
 
 
@@ -145,11 +147,19 @@ def join(a: RSeq, b: RSeq) -> RSeq:
 
 @jax.jit
 def join_checked(a: RSeq, b: RSeq):
-    """CRDT join: position-key union with tombstone-OR.  Same capacity
-    contract as every sorted lattice: a union exceeding capacity drops the
-    largest keys (detect via the returned count)."""
+    """CRDT join: path-key union with tombstone-OR.  Same capacity contract
+    as every sorted lattice: a union exceeding capacity drops the largest
+    keys — check the returned count host-side where that matters."""
+    # trace-time guard (depth/capacity are shape-static): zipping mismatched
+    # column counts in sorted_union would silently truncate the deeper
+    # levels and merge distinct elements as duplicates
+    if a.keys.shape != b.keys.shape:
+        raise ValueError(
+            f"RSeq shapes differ ({a.keys.shape} vs {b.keys.shape}): states "
+            "must share capacity and path depth to join"
+        )
     keys, vals, n = su.sorted_union(
-        _keys(a), _vals(a), _keys(b), _vals(b),
+        _key_cols(a), _vals(a), _key_cols(b), _vals(b),
         combine=_combine, out_size=a.capacity,
     )
     return _from_union(keys, vals), n
@@ -157,16 +167,22 @@ def join_checked(a: RSeq, b: RSeq):
 
 @jax.jit
 def insert(s: RSeq, key, elem) -> RSeq:
-    """Insert one identified element (the 8-int ``key`` is allocated
-    host-side by SeqWriter/alloc_key).  Requires a free slot."""
+    """Insert one identified element (the flattened ``key`` row is allocated
+    host-side by SeqWriter/alloc_key).  Requires a free slot — callers
+    (SeqWriter) check capacity host-side and raise CapacityExceeded."""
+    key = jnp.asarray(key, jnp.int32).reshape(1, -1)
+    if key.shape[-1] != s.keys.shape[-1]:
+        raise ValueError(
+            f"key row has {key.shape[-1]} columns, state expects "
+            f"{s.keys.shape[-1]} (depth mismatch)"
+        )
     one = RSeq(
-        **{c: jnp.full((1,), key[i], jnp.int32)
-           for i, c in enumerate(KEY_COLS)},
+        keys=key,
         elem=jnp.full((1,), elem, jnp.int32),
         removed=jnp.zeros((1,), bool),
     )
     keys, vals, _ = su.sorted_union(
-        _keys(s), _vals(s), _keys(one), _vals(one),
+        _key_cols(s), _vals(s), _key_cols(one), _vals(one),
         combine=_combine, out_size=s.capacity,
     )
     return _from_union(keys, vals)
@@ -175,9 +191,7 @@ def insert(s: RSeq, key, elem) -> RSeq:
 @jax.jit
 def delete(s: RSeq, key) -> RSeq:
     """Tombstone one element by identity (RGA delete: the position stays)."""
-    hit = jnp.ones_like(s.removed)
-    for i, c in enumerate(KEY_COLS):
-        hit = hit & (getattr(s, c) == key[i])
+    hit = jnp.all(s.keys == jnp.asarray(key, jnp.int32)[None, :], axis=-1)
     return s.replace(removed=s.removed | hit)
 
 
@@ -185,95 +199,275 @@ def to_list(s: RSeq):
     """Host decode: live payload ids in sequence order."""
     import numpy as np
 
-    live = (np.asarray(s.p1_hi) != int(SENTINEL)) & ~np.asarray(s.removed)
+    live = (np.asarray(s.keys[:, 0]) != int(SENTINEL)) & ~np.asarray(s.removed)
     return [int(e) for e in np.asarray(s.elem)[live]]
+
+
+# ---- tombstone GC adapter (crdt_tpu.models.tomb_gc) ----
+
+
+class GC_ADAPTER:
+    """Wire RSeq into the generic tombstone-GC machinery.  Identity = the
+    deepest-level (rid, seq) — thanks to the (MID, own-identity) stamping
+    the LAST level's identity columns always carry the element's own
+    writer identity, whatever its real depth.  Collecting a row is safe
+    for descendants: children embed *copies* of ancestor coordinates, not
+    references, so their sort position survives the ancestor's removal."""
+
+    @staticmethod
+    def key_cols(s: RSeq):
+        return _key_cols(s)
+
+    @staticmethod
+    def vals(s: RSeq):
+        return _vals(s)
+
+    @staticmethod
+    def combine(a, b):
+        return _combine(a, b)
+
+    @staticmethod
+    def from_union(keys, vals) -> RSeq:
+        return _from_union(keys, vals)
+
+    @staticmethod
+    def rid_seq(s: RSeq):
+        return s.keys[:, -2], s.keys[:, -1]
+
+    @staticmethod
+    def valid(s: RSeq):
+        return s.keys[:, 0] != SENTINEL
+
+    @staticmethod
+    def capacity_of(s: RSeq) -> int:
+        return s.capacity
+
+    @staticmethod
+    def removed_of(s: RSeq):
+        return s.removed
+
+    @staticmethod
+    def vals_zero_like(s: RSeq, mask):
+        return {
+            "elem": jnp.where(mask, 0, s.elem),
+            "removed": jnp.where(mask, False, s.removed),
+        }
 
 
 # ---- host-side identity allocation ------------------------------------------
 
 
-def _key_tuple(row):
-    """(p1, (rid1, seq1), p2, (rid2, seq2)) from an 8-int key row."""
-    return (
-        join_pos(row[0], row[1]), (row[2], row[3]),
-        join_pos(row[4], row[5]), (row[6], row[7]),
+def _triples(row, depth):
+    """[(pos, rid, seq)] levels from a flattened 4*D-int key row."""
+    return tuple(
+        (join_pos(row[4 * k], row[4 * k + 1]), int(row[4 * k + 2]),
+         int(row[4 * k + 3]))
+        for k in range(depth)
     )
 
 
-def alloc_key(left, right, rid: int, seq: int):
-    """Allocate the 8-int position key for an element between ``left`` and
-    ``right`` (8-int key rows, or None for begin/end).
+def _flatten(levels):
+    out = []
+    for pos, rid, seq in levels:
+        hi, lo = split_pos(pos)
+        out.extend((hi, lo, rid, seq))
+    return tuple(out)
 
-    Level 1 first; when its integer gap is exhausted (e.g. two concurrent
-    midpoint inserts collided and sit tie-broken side by side) the element
-    anchors deep on the LEFT neighbour.
+
+def _stamp(levels, rid, seq, depth):
+    """Pad real levels out to ``depth`` with the (MID, own-identity) stamp."""
+    return _flatten(tuple(levels) + ((MID, rid, seq),) * (depth - len(levels)))
+
+
+def real_depth(triples) -> int:
+    """Deepest level whose coordinate is a real allocation (never MID)."""
+    d = 1
+    for k, (pos, _, _) in enumerate(triples, start=1):
+        if pos != MID:
+            d = k
+    return d
+
+
+def _alloc_between(lo: int, hi: int, *, open_lo: bool, open_hi: bool) -> int:
+    """An integer strictly between lo and hi, never exactly MID.
+
+    Open ends stride (APPEND_STRIDE) instead of bisecting, so chained
+    allocations against an open end cost O(1) coordinate space each: an
+    ascending chain (appends / own-run siblings) strides up from lo, a
+    descending chain (prepends / fixed-index storms) strides down from hi.
+    A doubly-open gap (first element under an anchor, or the first element
+    ever) takes the midpoint so both directions keep equal room."""
+    if hi - lo < 2:
+        raise GapExhausted(f"no position left between {lo} and {hi}")
+    if open_lo and open_hi:
+        cand = (lo + hi) // 2
+    elif open_hi:
+        cand = lo + APPEND_STRIDE if lo + APPEND_STRIDE < hi else (lo + hi) // 2
+    elif open_lo:
+        cand = hi - APPEND_STRIDE if hi - APPEND_STRIDE > lo else (lo + hi) // 2
+    else:
+        cand = (lo + hi) // 2
+    if cand == MID:  # MID is reserved for the stamp rows
+        cand = MID + 1 if MID + 1 < hi else MID - 1
+        if not lo < cand < hi:
+            raise GapExhausted(f"only MID remains between {lo} and {hi}")
+    return cand
+
+
+def _row_cmp_key(row):
+    return tuple(int(x) for x in row)
+
+
+def alloc_key(left, right, rid: int, seq: int, depth: int = DEPTH):
+    """Allocate the flattened path key for an element strictly between
+    ``left`` and ``right`` (flattened key rows, or None for begin/end).
+
+    Level preference implements the docstring's anchoring rules:
+      1. sibling continuation of my own chain (left's identity is mine and
+         so is its parent level's) at left's own depth;
+      2. descend under left (the RGA anchor) at depth(left) + 1;
+      3. re-anchor sweep: any level with a representable gap, deepest
+         first — order stays correct by construction, only the
+         non-interleaving heuristic weakens.
     """
-    lt = _key_tuple(left) if left is not None else None
-    rt = _key_tuple(right) if right is not None else None
+    if left is None and right is None:
+        p = _alloc_between(-1, POS_MAX, open_lo=True, open_hi=True)
+        return _stamp([(p, rid, seq)], rid, seq, depth)
+    if left is None:
+        rt = _triples(right, depth)
+        p = _alloc_between(-1, rt[0][0], open_lo=True, open_hi=False)
+        return _stamp([(p, rid, seq)], rid, seq, depth)
 
-    lo1 = lt[0] if lt is not None else -1
-    hi1 = rt[0] if rt is not None else POS_MAX
-    try:
-        p1 = _alloc(lo1, hi1, stride_edges=True)
-        return (*split_pos(p1), rid, seq, *split_pos(MID), rid, seq)
-    except GapExhausted:
-        if lt is None:
-            # no left neighbour to anchor on: deep-before is unrepresentable
-            raise
-    # deep insert: anchor = left's level-1 triple.  If left is itself a top
-    # row (it IS the anchor, sitting at pos2 == MID) the deep child goes
-    # anywhere above MID; if left is already deep under this anchor, above
-    # left's own pos2.  The right neighbour constrains pos2 only when it is
-    # a deep row under the SAME anchor (any other right key is level-1
-    # greater and unreachable by pos2).
-    anchor_pos, anchor_id = lt[0], lt[1]
-    left_is_top = lt[2] == MID and lt[1] == lt[3]
-    lo2 = MID if left_is_top else lt[2]
-    hi2 = (
-        rt[2]
-        if rt is not None and rt[0] == anchor_pos and rt[1] == anchor_id
-        else POS_MAX
+    lt = _triples(left, depth)
+    rt = _triples(right, depth) if right is not None else None
+    d = real_depth(lt)
+
+    def bounds(k):
+        lo = lt[k - 1][0] if k <= d else MID
+        hi = rt[k - 1][0] if rt is not None and rt[: k - 1] == lt[: k - 1] \
+            else POS_MAX
+        return lo, hi
+
+    def try_level(k):
+        lo, hi = bounds(k)
+        try:
+            p = _alloc_between(
+                lo, hi,
+                open_lo=(lo == MID if k > 1 else lo == -1),
+                open_hi=(hi == POS_MAX),
+            )
+        except GapExhausted:
+            return None
+        return lt[: k - 1] + ((p, rid, seq),)
+
+    own = lt[d - 1][1] == rid
+    protected = d >= 2 and lt[d - 2][1] == rid
+    order = []
+    if own and protected:
+        order.append(d)           # sibling inside my own subtree
+    if d + 1 <= depth:
+        order.append(d + 1)       # descend under left
+    order += [k for k in range(depth, 0, -1) if k not in order]
+
+    for k in order:
+        levels = try_level(k)
+        if levels is not None:
+            row = _stamp(levels, rid, seq, depth)
+            # intention-preservation guard: loud failure beats silent
+            # misorder (a plain `if`, not an assert — identities are
+            # immutable, so a misordered insert could never be repaired,
+            # and asserts vanish under python -O)
+            if not _row_cmp_key(row) > _row_cmp_key(left) or not (
+                right is None or _row_cmp_key(row) < _row_cmp_key(right)
+            ):
+                raise AssertionError(
+                    f"allocated key not strictly between its neighbours "
+                    f"(level {k}): {row}"
+                )
+            return row
+    raise GapExhausted(
+        f"every level of the {depth}-deep gap between {lt[:d]} and "
+        f"{rt if rt is None else rt[:real_depth(rt)]} is bisected to "
+        "exhaustion (~58 adversarial collisions per level)"
     )
-    p2 = _alloc(lo2, hi2, stride_edges=False)
-    return (*split_pos(anchor_pos), *anchor_id, *split_pos(p2), rid, seq)
 
 
 class SeqWriter:
     """Host-side editing cursor for one writer: tracks identities so the
     caller edits by INDEX (insert_at / delete_at) like a normal list, while
-    the CRDT below works on immutable position identities."""
+    the CRDT below works on immutable position identities.
 
-    def __init__(self, state: RSeq, rid: int):
+    ``seq`` numbers are per-writer contiguous — the tombstone-GC floor
+    (crdt_tpu.models.tomb_gc) relies on that contiguity, and RE-MINTING a
+    previously used (rid, seq) is unsafe: if the old identity was GC'd,
+    the join suppression rule would silently drop the fresh insert.  By
+    default the counter resumes above the largest seq this writer has IN
+    ``state`` (safe for plain-RSeq restarts: a writer's own rows survive
+    until removed).  Deployments running tombstone GC must pass
+    ``seq_start=tomb_gc.next_seq(g, rid)`` (floor-aware) or persist the
+    counter across restarts like crdt_tpu.utils.clock.SeqGen."""
+
+    def __init__(self, state: RSeq, rid: int, seq_start: int | None = None):
         self.state = state
         self.rid = rid
-        self._seq = 0
+        if seq_start is None:
+            import numpy as np
 
-    def _live_keys(self):
-        """Ordered list of (key_row, row_index) for live elements."""
+            # own identity rides the LAST level's (rid, seq) columns —
+            # stamping repeats it there whatever the row's real depth
+            rids = np.asarray(state.keys[:, -2])
+            seqs = np.asarray(state.keys[:, -1])
+            valid = np.asarray(state.keys[:, 0]) != int(SENTINEL)
+            mine = valid & (rids == rid)
+            seq_start = int(seqs[mine].max(initial=-1)) + 1
+        self._seq = seq_start
+
+    def _snapshot(self):
+        """One host transfer of the key table: (np keys, occupied mask,
+        live row indices in order)."""
         import numpy as np
 
-        cols = [np.asarray(getattr(self.state, c)) for c in KEY_COLS]
-        live = (cols[0] != int(SENTINEL)) & ~np.asarray(self.state.removed)
-        return [
-            (tuple(int(c[i]) for c in cols), i)
-            for i in np.nonzero(live)[0]
-        ]
+        keys = np.asarray(self.state.keys)
+        occupied = keys[:, 0] != int(SENTINEL)
+        live = occupied & ~np.asarray(self.state.removed)
+        return keys, occupied, np.nonzero(live)[0]
 
-    def insert_at(self, index: int, elem: int) -> None:
-        rows = self._live_keys()
-        left = rows[index - 1][0] if index > 0 else None
-        right = rows[index][0] if index < len(rows) else None
+    @staticmethod
+    def _row(keys, idx):
+        return tuple(int(x) for x in keys[idx])
+
+    def _rows(self):
+        """Ordered list of live flattened key rows (tests/debug helper)."""
+        keys, _, live_idx = self._snapshot()
+        return [self._row(keys, i) for i in live_idx]
+
+    def insert_at(self, index: int | None, elem: int) -> None:
+        """Insert before position ``index`` (None = append) — one host
+        snapshot serves the capacity check and both neighbour lookups."""
+        keys, occupied, live_idx = self._snapshot()
+        if int(occupied.sum()) >= self.state.capacity:
+            raise CapacityExceeded(
+                f"RSeq table full ({int(occupied.sum())}/"
+                f"{self.state.capacity} rows, tombstones included) — grow "
+                "the capacity or run tombstone GC"
+            )
+        if index is None:
+            index = len(live_idx)
+        left = self._row(keys, live_idx[index - 1]) if index > 0 else None
+        right = (
+            self._row(keys, live_idx[index]) if index < len(live_idx) else None
+        )
         seq = self._seq
         self._seq += 1
-        key = alloc_key(left, right, self.rid, seq)
+        key = alloc_key(left, right, self.rid, seq, self.state.depth)
         self.state = insert(self.state, key, elem)
 
     def append(self, elem: int) -> None:
-        self.insert_at(len(self._live_keys()), elem)
+        self.insert_at(None, elem)
 
     def delete_at(self, index: int) -> None:
-        key = self._live_keys()[index][0]
-        self.state = delete(self.state, key)
+        keys, _, live_idx = self._snapshot()
+        self.state = delete(self.state, self._row(keys, live_idx[index]))
 
     def to_list(self):
         return to_list(self.state)

@@ -42,28 +42,37 @@ from crdt_tpu.utils.constants import SENTINEL
 LANES = 128
 
 
-def _merge_stages(keys, vals, n):
-    """The bitonic-merge compare-exchange network: ``keys``/``vals`` are
-    (n, LANES) with each column a bitonic sequence (ascending A ++
-    descending B); log2(n) stages at strides n/2..1 sort every column.
-    Shared by the plain-merge and fused-union kernels."""
+def _merge_stages_planes(planes, n, n_keys):
+    """The bitonic-merge compare-exchange network, generic over row width:
+    ``planes`` are (n, LANES) arrays whose columns are bitonic sequences
+    (ascending A ++ descending B); the first ``n_keys`` planes form the
+    lexicographic sort key and every plane swaps under the same mask;
+    log2(n) stages at strides n/2..1 sort every column.  Shared by the
+    plain-merge, OR-combine fused, and lex2 keep-first fused kernels."""
     stride = n // 2
     while stride >= 1:
         nb = n // (2 * stride)
-        k = keys.reshape(nb, 2, stride, LANES)
-        v = vals.reshape(nb, 2, stride, LANES)
-        k_lo, k_hi = k[:, 0], k[:, 1]
-        v_lo, v_hi = v[:, 0], v[:, 1]
-        swap = k_lo > k_hi
-        k = jnp.stack(
-            [jnp.where(swap, k_hi, k_lo), jnp.where(swap, k_lo, k_hi)], axis=1
-        )
-        v = jnp.stack(
-            [jnp.where(swap, v_hi, v_lo), jnp.where(swap, v_lo, v_hi)], axis=1
-        )
-        keys = k.reshape(n, LANES)
-        vals = v.reshape(n, LANES)
+        rs = [p.reshape(nb, 2, stride, LANES) for p in planes]
+        side_lo = [r[:, 0] for r in rs]
+        side_hi = [r[:, 1] for r in rs]
+        swap = side_lo[0] > side_hi[0]
+        eq = side_lo[0] == side_hi[0]
+        for k in range(1, n_keys):
+            swap = swap | (eq & (side_lo[k] > side_hi[k]))
+            eq = eq & (side_lo[k] == side_hi[k])
+        planes = [
+            jnp.stack(
+                [jnp.where(swap, h, l), jnp.where(swap, l, h)], axis=1
+            ).reshape(n, LANES)
+            for l, h in zip(side_lo, side_hi)
+        ]
         stride //= 2
+    return planes
+
+
+def _merge_stages(keys, vals, n):
+    """Single-key-plane wrapper over _merge_stages_planes."""
+    keys, vals = _merge_stages_planes([keys, vals], n, n_keys=1)
     return keys, vals
 
 
@@ -136,6 +145,53 @@ def _shift_down(x, s, fill):
     )
 
 
+def _hole_compact(key_planes, val_planes, n):
+    """Steps 3-4 of the fused union pipeline, shared by the OR-combine
+    (_union_kernel) and lex2 keep-first (_make_lex2_union_kernel) kernels:
+
+      3. displacement D[i] = holes strictly before row i, via a
+         Hillis-Steele prefix sum (log2(n) shift-adds);
+      4. compaction: log2(n) steps; at step 2^b every element whose
+         remaining displacement has bit b set moves up by 2^b.  Sorted
+         order makes displacements monotone per column, so take/keep never
+         collide (validated against a host oracle in tests).
+
+    A hole is a row whose PRIMARY key plane is SENTINEL (secondary key
+    planes and value planes ride along).  Returns (key_planes, val_planes,
+    nu_row): nu_row is the (1, L) true-unique count per lane, computed
+    pre-truncation so capacity overflow stays detectable."""
+    hole = key_planes[0] == SENTINEL
+    p = hole.astype(jnp.int32)
+    n_rows = key_planes[0].shape[0]
+    assert n_rows == n
+    s = 1
+    while s < n:
+        p = p + _shift_down(p, s, 0)
+        s *= 2
+    disp = jnp.where(hole, 0, p - hole.astype(jnp.int32))
+    # p's last row is the inclusive prefix sum = the column's hole count
+    nu_row = n - p[n - 1 : n]
+
+    s = 1
+    while s < n:
+        cand_k = [_shift_up(k, s, SENTINEL) for k in key_planes]
+        cand_v = [_shift_up(v, s, 0) for v in val_planes]
+        cand_d = _shift_up(disp, s, 0)
+        take = (cand_k[0] != SENTINEL) & ((cand_d & s) != 0)
+        keep = (key_planes[0] != SENTINEL) & ((disp & s) == 0)
+        key_planes = [
+            jnp.where(take, ck, jnp.where(keep, k, SENTINEL))
+            for ck, k in zip(cand_k, key_planes)
+        ]
+        val_planes = [
+            jnp.where(take, cv, jnp.where(keep, v, 0))
+            for cv, v in zip(cand_v, val_planes)
+        ]
+        disp = jnp.where(take, cand_d - s, jnp.where(keep, disp, 0))
+        s *= 2
+    return key_planes, val_planes, nu_row
+
+
 def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref, nu_ref):
     """FUSED columnar union: bitonic merge + adjacent-dup OR-combine +
     log-step hole compaction, entirely in VMEM — one HBM round trip for the
@@ -180,32 +236,8 @@ def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref, nu_ref):
     keys = jnp.where(dup, SENTINEL, keys)
     vals = jnp.where(dup, 0, vals)
 
-    # displacement = holes strictly before each row (Hillis-Steele)
-    hole = keys == SENTINEL
-    p = hole.astype(jnp.int32)
-    s = 1
-    while s < n:
-        p = p + _shift_down(p, s, 0)
-        s *= 2
-    disp = jnp.where(hole, 0, p - hole.astype(jnp.int32))
-
-    # true unique count per lane (pre-truncation): 2C minus holes; p's last
-    # row is the inclusive prefix sum = the column's total hole count
-    nu_ref[:] = n - p[n - 1:n]
-
-    # log-step compaction (monotone displacements: no collisions)
-    s = 1
-    while s < n:
-        cand_k = _shift_up(keys, s, SENTINEL)
-        cand_v = _shift_up(vals, s, 0)
-        cand_d = _shift_up(disp, s, 0)
-        take = (cand_k != SENTINEL) & ((cand_d & s) != 0)
-        keep = (keys != SENTINEL) & ((disp & s) == 0)
-        keys = jnp.where(take, cand_k, jnp.where(keep, keys, SENTINEL))
-        vals = jnp.where(take, cand_v, jnp.where(keep, vals, 0))
-        disp = jnp.where(take, cand_d - s, jnp.where(keep, disp, 0))
-        s *= 2
-
+    (keys,), (vals,), nu_row = _hole_compact([keys], [vals], n)
+    nu_ref[:] = nu_row
     ko_ref[:] = keys[:out_rows]
     vo_ref[:] = vals[:out_rows]
 
@@ -253,6 +285,126 @@ def sorted_union_columnar_fused(
         ),
     )(keys_a, vals_a, jnp.flip(keys_b, axis=0), jnp.flip(vals_b, axis=0))
     return ko, vo, nu[0]
+
+
+def _merge_stages_lex(planes, n):
+    """Two-word lexicographic wrapper over _merge_stages_planes:
+    ``planes[0]``/``planes[1]`` are the (hi, lo) key words and decide the
+    swap mask; every further plane (values) swaps under the same mask.
+    This is what lets the OpLog's 4-column (ts, rid, seq, key) identity
+    ride the kernel: ts is the hi word, (rid | seq | key) bit-pack into
+    the lo word (crdt_tpu.models.oplog_columnar)."""
+    return _merge_stages_planes(planes, n, n_keys=2)
+
+
+def _make_lex2_union_kernel(n_vals: int):
+    """Build the fused lex2-key union kernel for ``n_vals`` value planes.
+
+    Same fused pipeline as _union_kernel (merge → dup punch → prefix-sum
+    displacement → log-step compaction, one VMEM round trip) with two
+    differences: the sort key is the lexicographic (hi, lo) word pair, and
+    the duplicate rule is KEEP-FIRST — callers guarantee identical keys
+    carry identical values (CRDT op identity: the same (ts, rid, seq, key)
+    is the same op), so the second copy is simply punched to a hole and no
+    value combine is needed.
+    """
+
+    def kernel(*refs):
+        ins, outs = refs[: 4 + 2 * n_vals], refs[4 + 2 * n_vals:]
+        ka_hi, ka_lo = ins[0], ins[1]
+        va = ins[2 : 2 + n_vals]
+        kbr_hi, kbr_lo = ins[2 + n_vals], ins[3 + n_vals]
+        vb = ins[4 + n_vals :]
+        ko_hi, ko_lo = outs[0], outs[1]
+        vo = outs[2 : 2 + n_vals]
+        nu_ref = outs[2 + n_vals]
+
+        c = ka_hi.shape[0]
+        n = 2 * c
+        out_rows = ko_hi.shape[0]
+        planes = [
+            jnp.concatenate([ka_hi[:], kbr_hi[:]], axis=0),
+            jnp.concatenate([ka_lo[:], kbr_lo[:]], axis=0),
+        ] + [jnp.concatenate([a[:], b[:]], axis=0) for a, b in zip(va, vb)]
+        planes = _merge_stages_lex(planes, n)
+        khi, klo, vals = planes[0], planes[1], planes[2:]
+
+        # keep-first duplicate punch (one-row lookback: inputs have unique
+        # keys, so each key occurs at most twice in the merged columns)
+        prev_hi = _shift_down(khi, 1, SENTINEL)
+        prev_lo = _shift_down(klo, 1, SENTINEL)
+        dup = (khi == prev_hi) & (klo == prev_lo) & (khi != SENTINEL)
+        khi = jnp.where(dup, SENTINEL, khi)
+        klo = jnp.where(dup, SENTINEL, klo)
+        vals = [jnp.where(dup, 0, v) for v in vals]
+
+        (khi, klo), vals, nu_row = _hole_compact([khi, klo], vals, n)
+        nu_ref[:] = nu_row
+        ko_hi[:] = khi[:out_rows]
+        ko_lo[:] = klo[:out_rows]
+        for ref, v in zip(vo, vals):
+            ref[:] = v[:out_rows]
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("out_size", "interpret"))
+def sorted_union_columnar_fused_lex2(
+    keys_a,          # (hi, lo): pair of int32[C, L], per-lane sorted asc
+    vals_a,          # tuple of int32[C, L] value planes
+    keys_b,
+    vals_b,
+    out_size: int | None = None,
+    interpret: bool = False,
+):
+    """Fused batched sorted-set union with a two-word lexicographic key —
+    the OpLog fast path (crdt_tpu.models.oplog_columnar).  Contract mirrors
+    sorted_union_columnar_fused, except:
+
+    * keys are (hi, lo) pairs compared lexicographically (padding rows have
+      hi = lo = SENTINEL; real rows have hi < SENTINEL);
+    * duplicates resolve KEEP-FIRST: callers must guarantee identical keys
+      carry identical value rows (true for op logs: the key IS the op
+      identity) — this replaces the OR-combiner, which is wrong for
+      non-monotone payloads like numeric deltas;
+    * any number of int32 value planes travels through the network.
+
+    Returns ((hi, lo), vals_tuple, n_unique[L]); n_unique is the
+    pre-truncation unique count, so overflow (n_unique > out_size) stays
+    detectable."""
+    ka_hi, ka_lo = keys_a
+    kb_hi, kb_lo = keys_b
+    n_vals = len(vals_a)
+    assert n_vals == len(vals_b)
+    c, lanes = ka_hi.shape
+    assert c & (c - 1) == 0, f"capacity {c} must be a power of two"
+    assert lanes % LANES == 0, f"lane count {lanes} must be a multiple of {LANES}"
+    out = out_size if out_size is not None else 2 * c
+    assert out <= 2 * c, f"out_size {out} exceeds the 2C={2*c} union bound"
+    grid = (lanes // LANES,)
+    in_spec = pl.BlockSpec((c, LANES), lambda i: (0, i))
+    out_spec = pl.BlockSpec((out, LANES), lambda i: (0, i))
+    nu_spec = pl.BlockSpec((1, LANES), lambda i: (0, i))
+    outs = pl.pallas_call(
+        _make_lex2_union_kernel(n_vals),
+        grid=grid,
+        in_specs=[in_spec] * (4 + 2 * n_vals),
+        out_specs=[out_spec] * (2 + n_vals) + [nu_spec],
+        out_shape=[jax.ShapeDtypeStruct((out, lanes), jnp.int32)] * (2 + n_vals)
+        + [jax.ShapeDtypeStruct((1, lanes), jnp.int32)],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=112 * 1024 * 1024,
+        ),
+    )(
+        ka_hi,
+        ka_lo,
+        *vals_a,
+        jnp.flip(kb_hi, axis=0),
+        jnp.flip(kb_lo, axis=0),
+        *(jnp.flip(v, axis=0) for v in vals_b),
+    )
+    return (outs[0], outs[1]), tuple(outs[2 : 2 + n_vals]), outs[2 + n_vals][0]
 
 
 def _dedupe_and_compact(keys, vals, combine, out_size):

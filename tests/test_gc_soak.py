@@ -1,0 +1,36 @@
+"""CI sweep of the set-workload tombstone-GC soak (short schedules; the
+long mode mirrors the other fuzz suites' --long / CRDT_LONG knob)."""
+import pytest
+
+from crdt_tpu.harness.gc_soak import SetSoakRunner
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_gc_soak_short(seed):
+    report = SetSoakRunner(n=4, seed=seed, capacity=256).run(150)
+    assert report.steps == 150
+    # transparency/safety are asserted inside; reclamation must actually
+    # fire on schedules that ran barriers against a remove-heavy workload
+    if report.barriers:
+        assert report.rows_reclaimed > 0
+
+
+def test_gc_soak_reclaims_under_pressure():
+    """A remove-heavy schedule with frequent barriers must keep the table
+    bounded well below the total add count."""
+    r = SetSoakRunner(
+        n=3, seed=7, capacity=128, p_add=0.35, p_remove=0.25,
+        p_join=0.2, p_kill=0.0, p_revive=0.0, p_barrier=0.2,
+    ).run(400)
+    assert r.barriers >= 3
+    assert r.rows_reclaimed > 0
+    assert r.final_rows < r.adds, "GC failed to bound tombstone growth"
+
+
+def test_gc_soak_long():
+    import os
+
+    if not os.environ.get("CRDT_LONG"):
+        pytest.skip("long soak: set CRDT_LONG=1 (or pytest --long)")
+    for seed in range(10):
+        SetSoakRunner(n=5, seed=seed, capacity=1024).run(1500)

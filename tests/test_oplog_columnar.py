@@ -1,0 +1,171 @@
+"""Columnar OpLog fast path vs the generic row-major path (interpret mode
+on CPU; the Mosaic path is A/B-benched on hardware in
+benches/bench_oplog_columnar.py).  Ground truth: vmapped oplog.merge /
+swarm.converge over the same stacked states."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from crdt_tpu.models import oplog, oplog_columnar as oc
+from crdt_tpu.ops import joins
+from crdt_tpu.parallel import swarm
+from crdt_tpu.utils.constants import SENTINEL_PY
+
+BITS = (4, 22, 5)  # 16 writers x 4M seqs x 32 keys
+
+
+def _op_pool(rng, n, n_writers=8, n_keys=16):
+    """Unique (ts, rid, seq) identities with colliding ts values."""
+    ids = rng.choice(n * 4, size=n, replace=False)
+    return {
+        "ts": (ids // 16).astype(np.int32),  # collisions on purpose
+        "rid": rng.integers(0, n_writers, n).astype(np.int32),
+        "seq": ids.astype(np.int32),
+        "key": rng.integers(0, n_keys, n).astype(np.int32),
+        "val": rng.integers(-20, 20, n).astype(np.int32),
+        "payload": rng.integers(0, 1000, n).astype(np.int32),
+        "is_num": rng.integers(0, 2, n).astype(bool),
+    }
+
+
+def _random_batch(rng, r, c, pool):
+    """[R, C] stacked OpLog: each replica holds a random subset of the pool
+    (so cross-replica duplicates are plentiful)."""
+    n = len(pool["ts"])
+    logs = []
+    for _ in range(r):
+        take = np.nonzero(rng.random(n) < rng.random())[0][:c]  # varied fill
+        ops = {k: jnp.asarray(v[take]) for k, v in pool.items()}
+        logs.append(oplog.from_ops(c, ops))
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *logs)
+
+
+def _assert_logs_equal(a: oplog.OpLog, b: oplog.OpLog):
+    for f in ("ts", "rid", "seq", "key", "val", "payload", "is_num"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)), err_msg=f
+        )
+
+
+def test_stack_unstack_roundtrip():
+    rng = np.random.default_rng(0)
+    batch = _random_batch(rng, 6, 32, _op_pool(rng, 40))
+    col = oc.stack(batch, bits=BITS)
+    _assert_logs_equal(oc.unstack(col), batch)
+
+
+def test_stack_rejects_out_of_budget_fields():
+    rng = np.random.default_rng(1)
+    pool = _op_pool(rng, 10)
+    pool["key"][:] = 1 << 6  # exceeds the 5-bit key budget
+    batch = _random_batch(rng, 2, 16, pool)
+    with pytest.raises(ValueError, match="key range"):
+        oc.stack(batch, bits=BITS)
+
+
+def test_fit_bits():
+    bits = oc.fit_bits(n_writers=5, n_keys=62)
+    assert bits[0] == 3 and bits[2] == 6 and sum(bits) == 31
+    with pytest.raises(ValueError):
+        oc.check_bits((16, 16, 8))
+
+
+@pytest.mark.parametrize("c", [16, 64])
+def test_columnar_merge_matches_rowmajor(c):
+    rng = np.random.default_rng(c)
+    pool = _op_pool(rng, c)
+    a = _random_batch(rng, 8, c, pool)
+    b = _random_batch(rng, 8, c, pool)
+    merged, nu = oc.merge_checked(
+        oc.stack(a, bits=BITS), oc.stack(b, bits=BITS), interpret=True
+    )
+    want, want_nu = jax.vmap(oplog.merge_checked)(a, b)
+    _assert_logs_equal(oc.unstack(merged), want)
+    np.testing.assert_array_equal(np.asarray(nu), np.asarray(want_nu))
+
+
+def test_columnar_merge_overflow_detected():
+    rng = np.random.default_rng(7)
+    c = 16
+    # two disjoint pools whose union exceeds capacity
+    pa, pb = _op_pool(rng, c), _op_pool(rng, c)
+    pb["seq"] += 1000
+    a = [oplog.from_ops(c, {k: jnp.asarray(v) for k, v in pa.items()})]
+    b = [oplog.from_ops(c, {k: jnp.asarray(v) for k, v in pb.items()})]
+    a = jax.tree.map(lambda *xs: jnp.stack(xs), *a)
+    b = jax.tree.map(lambda *xs: jnp.stack(xs), *b)
+    merged, nu = oc.merge_checked(
+        oc.stack(a, bits=BITS), oc.stack(b, bits=BITS), interpret=True
+    )
+    assert int(nu[0]) == 2 * c > merged.capacity
+    want, _ = jax.vmap(oplog.merge_checked)(a, b)
+    _assert_logs_equal(oc.unstack(merged), want)
+
+
+@pytest.mark.parametrize("r", [4, 8, 11])
+def test_columnar_converge_matches_swarm(r):
+    rng = np.random.default_rng(r)
+    c = 32
+    batch = _random_batch(rng, r, c, _op_pool(rng, 24))
+    got, max_nu = oc.converge_checked(oc.stack(batch, bits=BITS), interpret=True)
+    s = swarm.converge(
+        swarm.make(batch), joins.batched(oplog.merge), oplog.empty(c)
+    )
+    _assert_logs_equal(oc.unstack(got), s.state)
+    assert int(max_nu) <= c
+
+
+def test_columnar_converge_respects_alive_mask():
+    rng = np.random.default_rng(42)
+    c, r = 32, 8
+    batch = _random_batch(rng, r, c, _op_pool(rng, 24))
+    alive = jnp.asarray(rng.integers(0, 2, r).astype(bool).tolist())
+    alive = alive.at[0].set(True)  # at least one alive
+    got = oc.converge(oc.stack(batch, bits=BITS), alive=alive, interpret=True)
+    s = swarm.converge(
+        swarm.make(batch, alive), joins.batched(oplog.merge), oplog.empty(c)
+    )
+    _assert_logs_equal(oc.unstack(got), s.state)
+
+
+def test_columnar_gossip_round_matches_swarm():
+    rng = np.random.default_rng(3)
+    c, r = 32, 8
+    batch = _random_batch(rng, r, c, _op_pool(rng, 24))
+    alive = jnp.asarray([True, False, True, True, True, False, True, True])
+    peers = jnp.asarray(rng.integers(0, r, r).astype(np.int32))
+    got = oc.gossip_round(
+        oc.stack(batch, bits=BITS), peers, alive=alive, interpret=True
+    )
+    s = swarm.gossip_round(
+        swarm.make(batch, alive), peers, joins.batched(oplog.merge)
+    )
+    _assert_logs_equal(oc.unstack(got), s.state)
+
+
+def test_columnar_rebuild_matches_rowmajor():
+    rng = np.random.default_rng(5)
+    c, n_keys = 32, 32
+    batch = _random_batch(rng, 4, c, _op_pool(rng, 24, n_keys=n_keys))
+    kv = oc.rebuild(oc.stack(batch, bits=BITS), n_keys)
+    want = jax.vmap(lambda lg: oplog.rebuild(lg, n_keys))(batch)
+    for f in ("present", "is_num", "num", "num_count", "payload"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(kv, f)), np.asarray(getattr(want, f)), err_msg=f
+        )
+
+
+def test_payload_sign_bit_carries_is_num():
+    """pay plane = payload | is_num<<31 must round-trip both fields."""
+    rng = np.random.default_rng(9)
+    batch = _random_batch(rng, 3, 16, _op_pool(rng, 12))
+    col = oc.stack(batch, bits=BITS)
+    back = oc.unstack(col)
+    valid = np.asarray(batch.ts) != SENTINEL_PY
+    np.testing.assert_array_equal(
+        np.asarray(back.is_num)[valid], np.asarray(batch.is_num)[valid]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(back.payload)[valid], np.asarray(batch.payload)[valid]
+    )

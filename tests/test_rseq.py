@@ -166,8 +166,184 @@ def test_deep_inserts_converge_across_writers():
 
 def test_gap_exhaustion_raises():
     with pytest.raises(rseq.GapExhausted):
-        rseq._alloc(100, 101, stride_edges=False)
-    assert 100 < rseq._alloc(100, 103, stride_edges=False) < 103
+        rseq._alloc_between(100, 101, open_lo=False, open_hi=False)
+    assert 100 < rseq._alloc_between(
+        100, 103, open_lo=False, open_hi=False
+    ) < 103
+    # MID is reserved for stamp rows and never allocated
+    with pytest.raises(rseq.GapExhausted):
+        rseq._alloc_between(rseq.MID - 1, rseq.MID + 1,
+                            open_lo=False, open_hi=False)
+    p = rseq._alloc_between(rseq.MID - 2, rseq.MID + 1,
+                            open_lo=False, open_hi=False)
+    assert p != rseq.MID
+
+
+def test_no_character_interleaving_forward_runs():
+    """Two writers type runs concurrently into the SAME gap; after the join
+    each run must stay contiguous (the RGA/Fugue forward-typing guarantee —
+    the round-1 verdict's required property test).  Checked for fresh gaps,
+    gaps between existing elements, and at the document end."""
+    for prefix, suffix in ([], []), ([1], [9]), ([1, 2], []), ([], [9]):
+        base = rseq.SeqWriter(rseq.empty(256), rid=0)
+        for i, ch in enumerate(prefix + suffix):
+            base.insert_at(i, ch)
+        gap = len(prefix)
+        x = rseq.SeqWriter(base.state, rid=1)
+        y = rseq.SeqWriter(base.state, rid=2)
+        run_x = [100 + i for i in range(12)]
+        run_y = [200 + i for i in range(9)]
+        for i, ch in enumerate(run_x):   # forward typing: each char goes
+            x.insert_at(gap + i, ch)     # right after the previous one
+        for i, ch in enumerate(run_y):
+            y.insert_at(gap + i, ch)
+        merged = rseq.to_list(rseq.join(x.state, y.state))
+        assert merged == prefix + run_x + run_y + suffix, (prefix, suffix)
+
+
+def test_no_interleaving_after_collision_point():
+    """Same property when the runs start on TOP of an existing tie-broken
+    collision pair (regression: the old two-level scheme interleaved here)."""
+    base = rseq.SeqWriter(rseq.empty(512), rid=0)
+    base.append(1)
+    base.append(4)
+    a = rseq.SeqWriter(base.state, rid=1)
+    b = rseq.SeqWriter(base.state, rid=2)
+    for i in range(20):
+        a.insert_at(1 + i, 100 + i)
+    for i in range(20):
+        b.insert_at(1 + i, 200 + i)
+    merged = rseq.to_list(rseq.join(a.state, b.state))
+    assert merged == [1] + [100 + i for i in range(20)] + \
+        [200 + i for i in range(20)] + [4]
+
+
+def test_same_gap_storm_10k_alloc_level():
+    """10K-op adversarial same-gap insert storm (verdict item 6 'done'
+    criterion), allocation-level: three writers with interleaved schedules
+    keep inserting at one fixed index; no GapExhausted, final order
+    correct, and the keys really sort the way the inserts intended."""
+    rng = np.random.default_rng(0)
+    l_row = rseq.alloc_key(None, None, 99, 0)
+    r_row = rseq.alloc_key(l_row, None, 99, 1)
+    # (key_row, label) in intended order, newest-at-gap-front semantics:
+    # every insert lands between l_row and the previously inserted element
+    rows = []
+    seqs = {1: 0, 2: 0, 3: 0}
+    for i in range(10_000):
+        rid = int(rng.integers(1, 4))
+        right = rows[0][0] if rows else r_row
+        key = rseq.alloc_key(l_row, right, rid, seqs[rid], rseq.DEPTH)
+        seqs[rid] += 1
+        rows.insert(0, (key, i))
+    ordered = sorted([(l_row, -1)] + rows + [(r_row, 10_000)],
+                     key=lambda kv: kv[0])
+    labels = [lab for _, lab in ordered]
+    assert labels[0] == -1 and labels[-1] == 10_000
+    assert labels[1:-1] == list(range(9_999, -1, -1))
+
+
+def test_same_gap_storm_device_table():
+    """A 1.5K-op fixed-index storm through the real device table: no
+    GapExhausted, no capacity overflow, order preserved end to end."""
+    w = rseq.SeqWriter(rseq.empty(2048), rid=0)
+    w.append(-1)
+    w.append(-2)
+    n = 1500
+    for i in range(n):
+        w.insert_at(1, i)   # always between -1 and the newest element
+    assert w.to_list() == [-1] + list(range(n - 1, -1, -1)) + [-2]
+
+
+def test_forward_typing_run_keeps_depth_flat():
+    """A long single-writer typing run must not grow path depth per char
+    (sibling continuation): depth stays ≤ anchor depth + 1."""
+    w = rseq.SeqWriter(rseq.empty(1024), rid=7)
+    for i in range(600):
+        w.insert_at(i, i)
+    rows = w._rows()
+    depths = {rseq.real_depth(rseq._triples(r, rseq.DEPTH)) for r in rows}
+    assert max(depths) <= 2, depths
+
+
+def test_capacity_exceeded_raises_loudly():
+    """ADVICE round 1: a full table must refuse inserts, not silently drop
+    the largest position key — and tombstones count as occupancy."""
+    w = rseq.SeqWriter(rseq.empty(8), rid=0)
+    for i in range(8):
+        w.append(i)
+    with pytest.raises(rseq.CapacityExceeded):
+        w.append(99)
+    w.delete_at(0)  # tombstone frees nothing until GC
+    with pytest.raises(rseq.CapacityExceeded):
+        w.append(99)
+
+
+def test_nested_collisions_survive_beyond_two_levels():
+    """Adversarial nested midpoint collisions: pairs of writers repeatedly
+    collide inside the same gap, then a third inserts between the collided
+    twins — the round-1 design died at two levels; this must keep going."""
+    base = rseq.SeqWriter(rseq.empty(512), rid=0)
+    base.append(1)
+    base.append(2)
+    state = base.state
+    rid = 10
+    for round_ in range(8):
+        a = rseq.SeqWriter(state, rid=rid)
+        b = rseq.SeqWriter(state, rid=rid + 1)
+        a.insert_at(1, 100 + round_)        # same gap, concurrently
+        b.insert_at(1, 200 + round_)
+        state = rseq.join(a.state, b.state)
+        c = rseq.SeqWriter(state, rid=rid + 2)
+        c.insert_at(2, 300 + round_)        # between the collided twins
+        state = c.state
+        rid += 3
+    lst = rseq.to_list(state)
+    assert len(lst) == 2 + 8 * 3
+    assert lst[0] == 1 and lst[-1] == 2
+
+
+def test_random_fuzz_converges_and_preserves_intent():
+    """Randomized concurrent editing: writers branch, edit independently,
+    and every pairwise join must agree regardless of order; every insert's
+    (left, right) intention is checked by alloc_key's internal guard."""
+    rng = np.random.default_rng(1234)
+    for trial in range(10):
+        base = rseq.SeqWriter(rseq.empty(512), rid=0)
+        for i in range(rng.integers(0, 6)):
+            base.insert_at(i, i)
+        writers = [
+            rseq.SeqWriter(base.state, rid=1 + k) for k in range(3)
+        ]
+        for w in writers:
+            for _ in range(rng.integers(5, 25)):
+                n = len(w.to_list())
+                if n and rng.random() < 0.3:
+                    w.delete_at(int(rng.integers(0, n)))
+                else:
+                    w.insert_at(int(rng.integers(0, n + 1)),
+                                int(rng.integers(0, 1000)))
+        states = [w.state for w in writers]
+        top = states[0]
+        for s in states[1:]:
+            top = rseq.join(top, s)
+        lists = {tuple(rseq.to_list(rseq.join(s, top))) for s in states}
+        assert len(lists) == 1, f"trial {trial} diverged"
+
+
+def test_seqwriter_restart_does_not_remint_identities():
+    """A restarted writer (default seq_start) must resume ABOVE its own
+    largest in-table seq — re-minting a used (rid, seq) would collide two
+    distinct elements (and be silently GC-suppressed under tomb_gc)."""
+    w = rseq.SeqWriter(rseq.empty(CAP), rid=3)
+    for i in range(5):
+        w.append(i)
+    w2 = rseq.SeqWriter(w.state, rid=3)  # restart, counter not persisted
+    assert w2._seq == 5
+    w2.append(99)
+    assert w2.to_list() == [0, 1, 2, 3, 4, 99]
+    # a different writer starts fresh at 0
+    assert rseq.SeqWriter(w.state, rid=4)._seq == 0
 
 
 def test_append_and_prepend_use_stride_not_bisection():

@@ -1,0 +1,227 @@
+"""Tombstone GC (crdt_tpu.models.tomb_gc): transparency, capacity
+reclamation, resurrection prevention, late-tombstone preservation, and the
+floor chain rule — for both OR-Set and RSeq adapters."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crdt_tpu.models import orset, rseq, tomb_gc
+from crdt_tpu.parallel import swarm
+
+W = 4       # writers == replicas
+CAP = 64
+AD = orset.GC_ADAPTER
+
+
+def _add(g, elem, rid, seq):
+    return g.replace(inner=orset.add(g.inner, elem, rid, seq))
+
+
+def _remove(g, elem):
+    return g.replace(inner=orset.remove(g.inner, elem))
+
+
+def _members(g):
+    return set(np.nonzero(np.asarray(orset.member_mask(g.inner, 100)))[0])
+
+
+def _stack(states):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def _unstack(sw_state, r):
+    return [jax.tree.map(lambda x: x[i], sw_state) for i in range(r)]
+
+
+def _join(a, b):
+    return tomb_gc.join(a, b, AD)
+
+
+def test_received_vv_and_floor_clamp():
+    g = tomb_gc.wrap(orset.empty(CAP), W)
+    g = _add(g, 5, 1, 0)
+    g = _add(g, 6, 1, 1)
+    g = _add(g, 7, 3, 0)
+    vv = np.asarray(tomb_gc.received_vv(g, AD))
+    assert vv.tolist() == [-1, 1, -1, 0]
+    # collect clamps to received: a floor beyond knowledge must not stick
+    g2 = tomb_gc.collect(g, jnp.asarray([5, 5, 5, 5], jnp.int32), AD)
+    assert np.asarray(g2.floor).tolist() == [-1, 1, -1, 0]
+
+
+def test_gc_reclaims_capacity_and_is_transparent():
+    g = tomb_gc.wrap(orset.empty(CAP), W)
+    for i in range(20):
+        g = _add(g, i, 0, i)
+    for i in range(15):
+        g = _remove(g, i)
+    states = [g for _ in range(W)]  # fully converged swarm
+    sw = swarm.make(_stack(states))
+    before = _members(states[0])
+    sw2 = tomb_gc.gc_round(sw, AD, orset.empty(CAP))
+    after = _unstack(sw2.state, W)
+    for rep in after:
+        assert _members(rep) == before, "GC must not change the member set"
+        assert int(orset.size(rep.inner)) == 5, "tombstoned rows reclaimed"
+        assert np.asarray(rep.floor).tolist() == [19, -1, -1, -1]
+
+
+def test_no_resurrection_from_stale_replica():
+    """C holds a tag live, misses the remove AND the GC barrier; its rejoin
+    must not resurrect the element."""
+    c = tomb_gc.wrap(orset.empty(CAP), W)
+    c = _add(c, 5, 2, 0)
+    a = b = c  # gossiped to everyone
+    a = _remove(a, 5)
+    b = _join(b, a)  # B learns the tombstone; C does not (dead)
+    sw = swarm.make(_stack([a, b, c]), jnp.asarray([True, True, False]))
+    sw = tomb_gc.gc_round(sw, AD, orset.empty(CAP))
+    a2, b2, c2 = _unstack(sw.state, 3)
+    assert int(orset.size(a2.inner)) == 0  # collected
+    assert np.asarray(c2.floor).tolist() == [-1] * W  # C untouched
+    rejoined = _join(c2, a2)
+    assert _members(rejoined) == set()
+    assert int(orset.size(rejoined.inner)) == 0
+    # and the other direction (A pulls from stale C) agrees
+    assert _members(_join(a2, c2)) == set()
+
+
+def test_late_tombstone_still_applies():
+    """C removed the tag locally but never gossiped it out, then missed the
+    barrier; the element is live (and floor-covered) everywhere else.  C's
+    rejoin must apply the removal, not lose it."""
+    c = tomb_gc.wrap(orset.empty(CAP), W)
+    c = _add(c, 5, 2, 0)
+    a = b = c
+    c = _remove(c, 5)  # only C knows
+    sw = swarm.make(_stack([a, b, c]), jnp.asarray([True, True, False]))
+    sw = tomb_gc.gc_round(sw, AD, orset.empty(CAP))
+    a2, b2, c2 = _unstack(sw.state, 3)
+    assert _members(a2) == {5}  # live rows are never collected
+    assert np.asarray(a2.floor).tolist() == [-1, -1, 0, -1]
+    rejoined = _join(a2, c2)
+    assert _members(rejoined) == set(), "late tombstone must OR in"
+    # a later barrier collects the now-tombstoned row
+    sw3 = swarm.make(_stack([rejoined, rejoined, rejoined]))
+    sw3 = tomb_gc.gc_round(sw3, AD, orset.empty(CAP))
+    assert int(orset.size(_unstack(sw3.state, 3)[0].inner)) == 0
+
+
+def test_floor_chain_and_advance_with_dead_replica():
+    """Barriers keep advancing while a replica is dead (its stale floor is
+    dominated), and the revived replica catches up through one join."""
+    g = tomb_gc.wrap(orset.empty(CAP), W)
+    g = _add(g, 1, 0, 0)
+    g = _remove(g, 1)
+    sw = swarm.make(_stack([g, g, g]))
+    sw = tomb_gc.gc_round(sw, AD, orset.empty(CAP))  # barrier 1: all alive
+    states = _unstack(sw.state, 3)
+    # replica 2 dies; 0 and 1 keep writing and hold barrier 2
+    a, b = states[0], states[1]
+    a = _add(a, 2, 0, 1)
+    a = _remove(a, 2)
+    b = _join(b, a)
+    sw2 = swarm.make(_stack([a, b, states[2]]),
+                     jnp.asarray([True, True, False]))
+    sw2 = tomb_gc.gc_round(sw2, AD, orset.empty(CAP))
+    a2, b2, c2 = _unstack(sw2.state, 3)
+    assert np.asarray(a2.floor).tolist() == [1, -1, -1, -1]
+    assert np.asarray(c2.floor).tolist() == [0, -1, -1, -1]  # stale chain
+    rejoined = _join(c2, a2)
+    assert np.asarray(rejoined.floor).tolist() == [1, -1, -1, -1]
+    assert _members(rejoined) == set()
+
+
+def test_gc_join_laws_on_simulated_history():
+    """Commutativity/associativity/idempotence of the GC-aware join over
+    states produced by a realistic history (adds, removes, gossip,
+    barriers) — floors stay chain-comparable, which is the precondition."""
+    rng = np.random.default_rng(7)
+    states = [tomb_gc.wrap(orset.empty(CAP), W) for _ in range(W)]
+    seqs = [0] * W
+    for step in range(40):
+        r = int(rng.integers(0, W))
+        if rng.random() < 0.6:
+            states[r] = _add(states[r], int(rng.integers(0, 30)), r, seqs[r])
+            seqs[r] += 1
+        else:
+            m = _members(states[r])
+            if m:
+                states[r] = _remove(states[r], int(rng.choice(sorted(m))))
+        if rng.random() < 0.3:
+            i, j = rng.choice(W, 2, replace=False)
+            states[int(i)] = _join(states[int(i)], states[int(j)])
+        if step % 13 == 12:
+            sw = tomb_gc.gc_round(swarm.make(_stack(states)), AD,
+                                  orset.empty(CAP))
+            states = _unstack(sw.state, W)
+
+    from tests.helpers import tree_equal
+
+    a, b, c = states[0], states[1], states[2]
+    assert tree_equal(_join(a, b), _join(b, a))
+    assert tree_equal(_join(_join(a, b), c), _join(a, _join(b, c)))
+    assert tree_equal(_join(a, a), a)
+
+
+def test_next_seq_is_floor_aware():
+    """After GC collects a writer's rows, the table max understates the used
+    seq range; next_seq must resume above the floor instead."""
+    g = tomb_gc.wrap(orset.empty(CAP), W)
+    for i in range(5):
+        g = _add(g, i, 1, i)
+    for i in range(5):
+        g = _remove(g, i)
+    sw = tomb_gc.gc_round(swarm.make(_stack([g, g])), AD, orset.empty(CAP))
+    g2 = _unstack(sw.state, 2)[0]
+    assert int(orset.size(g2.inner)) == 0  # all collected: table is empty
+    assert tomb_gc.next_seq(g2, AD, 1) == 5
+    assert tomb_gc.next_seq(g2, AD, 0) == 0
+
+
+# ---- RSeq adapter ----------------------------------------------------------
+
+
+def test_rseq_gc_reclaims_and_preserves_order():
+    w = rseq.SeqWriter(rseq.empty(CAP), rid=0)
+    for i in range(20):
+        w.append(i)
+    for _ in range(10):
+        w.delete_at(3)  # delete 3..12
+    g = tomb_gc.wrap(w.state, W)
+    before = rseq.to_list(g.inner)
+    assert int(rseq.n_rows(g.inner)) == 20
+    sw = tomb_gc.gc_round(swarm.make(_stack([g, g, g])), rseq.GC_ADAPTER,
+                          rseq.empty(CAP))
+    g2 = _unstack(sw.state, 3)[0]
+    assert rseq.to_list(g2.inner) == before
+    assert int(rseq.n_rows(g2.inner)) == 10, "tombstones reclaimed"
+    # editing continues on the collected table (anchors embed coordinate
+    # copies, so surviving rows still order correctly)
+    w2 = rseq.SeqWriter(g2.inner, rid=1)
+    w2.insert_at(5, 99)
+    assert rseq.to_list(w2.state)[5] == 99
+    # a stale pre-GC state cannot resurrect the deleted run
+    stale = tomb_gc.wrap(w.state, W)  # still has the tombstoned rows
+    rejoined = tomb_gc.join(g2.replace(inner=w2.state), stale,
+                            rseq.GC_ADAPTER)
+    assert rseq.to_list(rejoined.inner) == rseq.to_list(w2.state)
+
+
+def test_rseq_gc_no_resurrection_from_dead_writer():
+    base = rseq.SeqWriter(rseq.empty(CAP), rid=0)
+    for i in range(5):
+        base.append(i)
+    shared = tomb_gc.wrap(base.state, W)
+    # replica 2 (dead soon) holds the full list; 0 deletes an element
+    wa = rseq.SeqWriter(shared.inner, rid=1)
+    wa.delete_at(2)
+    a = shared.replace(inner=wa.state)
+    sw = swarm.make(_stack([a, a, shared]), jnp.asarray([True, True, False]))
+    sw = tomb_gc.gc_round(sw, rseq.GC_ADAPTER, rseq.empty(CAP))
+    a2, _, c2 = _unstack(sw.state, 3)
+    assert rseq.to_list(a2.inner) == [0, 1, 3, 4]
+    assert int(rseq.n_rows(a2.inner)) == 4
+    rejoined = tomb_gc.join(c2, a2, rseq.GC_ADAPTER)
+    assert rseq.to_list(rejoined.inner) == [0, 1, 3, 4]
