@@ -225,11 +225,11 @@ class SetSoakRunner:
             if self.alive[i] and lub is not None:
                 self.mirrors[i] = lub.copy()
         rows_after = sum(self._rows(i) for i in range(self.n))
+        self.report.barriers += 1  # every executed barrier counts
         if rows_after < rows_before:
-            self.report.barriers += 1
             self.report.rows_reclaimed += rows_before - rows_after
         else:
-            self.report.barriers_noop += 1
+            self.report.barriers_noop += 1  # ran but found nothing to drop
         for i in range(self.n):
             self._check(i, "barrier")
 

@@ -259,6 +259,29 @@ def mask_dead(col: ColumnarOpLog, alive: jax.Array) -> ColumnarOpLog:
     )
 
 
+def lub_lane(
+    col: ColumnarOpLog, alive: jax.Array | None = None, interpret: bool = False
+):
+    """Log-depth lane-halving tree reduction to a SINGLE-lane least upper
+    bound of the alive lanes (dead lanes contribute the join identity).
+    Returns (one-lane ColumnarOpLog, max_n_unique across the reduction).
+    The building block of converge/sharded_converge."""
+    work = col if alive is None else mask_dead(col, alive)
+    p = 1
+    while p < col.lanes:
+        p *= 2
+    work = _pad_lanes(work, p)
+    max_nu = jnp.zeros((), jnp.int32)
+    while p > 1:
+        p //= 2
+        work, nu = merge_checked(
+            _slice_lanes(work, 0, p), _slice_lanes(work, p, 2 * p),
+            interpret=interpret,
+        )
+        max_nu = jnp.maximum(max_nu, nu.max())
+    return work, max_nu
+
+
 def converge_checked(
     col: ColumnarOpLog, alive: jax.Array | None = None, interpret: bool = False
 ):
@@ -270,19 +293,7 @@ def converge_checked(
     means some pairwise union overflowed (newest ops dropped) — the same
     silent-truncation contract as the generic path, made checkable."""
     lanes = col.lanes
-    work = col if alive is None else mask_dead(col, alive)
-    p = 1
-    while p < lanes:
-        p *= 2
-    work = _pad_lanes(work, p)
-    max_nu = jnp.zeros((), jnp.int32)
-    while p > 1:
-        p //= 2
-        work, nu = merge_checked(
-            _slice_lanes(work, 0, p), _slice_lanes(work, p, 2 * p),
-            interpret=interpret,
-        )
-        max_nu = jnp.maximum(max_nu, nu.max())
+    work, max_nu = lub_lane(col, alive, interpret=interpret)
     top = jax.tree.map(
         lambda x: jnp.broadcast_to(x[:, :1], (col.capacity, lanes)), work
     )
@@ -321,3 +332,82 @@ def rebuild(col: ColumnarOpLog, n_keys: int) -> oplog.KVState:
     """Per-lane materialized view (batched KVState over the lane axis):
     unpack + the standard two-scatter rebuild (oplog.rebuild)."""
     return jax.vmap(lambda lg: oplog.rebuild(lg, n_keys))(unstack(col))
+
+
+def sharded_converge(
+    mesh,
+    bits=DEFAULT_BITS,
+    axis: str = "replica",
+    interpret: bool | None = None,
+):
+    """Multi-chip columnar convergence: the lane (replica) axis sharded
+    over a device mesh, the fused kernel doing every merge.
+
+    Build once per mesh; the returned jitted ``step(col, alive)`` runs one
+    global anti-entropy fixpoint and returns ``(col, max_n_unique)``:
+
+      1. each device tree-reduces its local lane shard to a one-lane LUB
+         (lub_lane — all Pallas merges, no cross-device traffic);
+      2. one ``all_gather`` ships the P single-lane LUBs over ICI/DCN —
+         the ONLY collective, moving 4 planes × C rows × P lanes;
+      3. each device reduces the gathered lanes to the global LUB and
+         broadcasts it over its local alive lanes.
+
+    This is the columnar sibling of parallel.mesh.sharded_converge: same
+    barrier semantics, but local reduction work rides the fused kernel
+    instead of the generic XLA sort.  ``interpret`` defaults to True off
+    TPU (CPU meshes — tests, the driver's virtual-device dryrun) and
+    False on TPU."""
+    from jax.sharding import PartitionSpec as P
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def local_step(hi, lo, val, pay, alive):
+        col = ColumnarOpLog(hi=hi, lo=lo, val=val, pay=pay, bits=tuple(bits))
+        local_lub, nu_local = lub_lane(col, alive, interpret=interpret)
+        gathered = jax.tree.map(
+            lambda x: jax.lax.all_gather(x, axis, axis=1, tiled=True),
+            local_lub,
+        )
+        top, nu_global = lub_lane(gathered, interpret=interpret)
+        out = jax.tree.map(
+            lambda t, x: jnp.where(
+                alive[None, :],
+                jnp.broadcast_to(t[:, :1], x.shape), x,
+            ),
+            top, col,
+        )
+        # per-device nu_local values differ: pmax them so the P() out_spec
+        # (replicated scalar) is truthful
+        max_nu = jax.lax.pmax(jnp.maximum(nu_local, nu_global), axis)
+        return out.hi, out.lo, out.val, out.pay, max_nu
+
+    shmapped = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(None, axis),) * 4 + (P(axis),),
+        out_specs=(P(None, axis),) * 4 + (P(),),
+        # pallas_call's out_shapes carry no varying-mesh-axes annotation,
+        # which the vma checker rejects; the manual pmax above keeps the
+        # replicated scalar out_spec truthful without it
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(col: ColumnarOpLog, alive: jax.Array):
+        if col.bits != tuple(bits):
+            raise ValueError(
+                f"state packed with bits={col.bits} but this step was built "
+                f"for bits={tuple(bits)}: the output would be relabeled and "
+                "unpack to garbage"
+            )
+        hi, lo, val, pay, max_nu = shmapped(
+            col.hi, col.lo, col.val, col.pay, alive
+        )
+        return (
+            ColumnarOpLog(hi=hi, lo=lo, val=val, pay=pay, bits=tuple(bits)),
+            max_nu,
+        )
+
+    return step

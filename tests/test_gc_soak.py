@@ -9,10 +9,11 @@ from crdt_tpu.harness.gc_soak import SetSoakRunner
 def test_gc_soak_short(seed):
     report = SetSoakRunner(n=4, seed=seed, capacity=256).run(150)
     assert report.steps == 150
-    # transparency/safety are asserted inside; reclamation must actually
-    # fire on schedules that ran barriers against a remove-heavy workload
-    if report.barriers:
-        assert report.rows_reclaimed > 0
+    # transparency/safety are asserted inside every step; these pinned
+    # seeds all run barriers against workloads with removes, so
+    # reclamation must actually fire (checked empirically: 18-26 rows)
+    assert report.barriers > report.barriers_noop
+    assert report.rows_reclaimed > 0
 
 
 def test_gc_soak_reclaims_under_pressure():

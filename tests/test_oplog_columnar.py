@@ -156,6 +156,33 @@ def test_columnar_rebuild_matches_rowmajor():
         )
 
 
+def test_sharded_converge_matches_single_device():
+    """Columnar convergence with the lane axis sharded over the 8-device
+    virtual CPU mesh must equal the single-device converge (and the
+    generic swarm path), dead lanes included."""
+    from crdt_tpu.parallel import mesh as mesh_lib
+
+    rng = np.random.default_rng(11)
+    c, r = 32, 16  # 2 lanes per device
+    batch = _random_batch(rng, r, c, _op_pool(rng, 24))
+    alive = jnp.asarray([True] * 14 + [False, True])
+    col = oc.stack(batch, bits=BITS)
+    m = mesh_lib.make_mesh(8)
+    step = oc.sharded_converge(m, bits=BITS)  # interpret: auto (cpu)
+    sharded_col = jax.device_put(
+        col,
+        jax.sharding.NamedSharding(m, jax.sharding.PartitionSpec(None, "replica")),
+    )
+    got, max_nu = step(sharded_col, alive)
+    want = oc.converge(col, alive=alive, interpret=True)
+    _assert_logs_equal(oc.unstack(got), oc.unstack(want))
+    assert int(max_nu) <= c
+    s = swarm.converge(
+        swarm.make(batch, alive), joins.batched(oplog.merge), oplog.empty(c)
+    )
+    _assert_logs_equal(oc.unstack(got), s.state)
+
+
 def test_payload_sign_bit_carries_is_num():
     """pay plane = payload | is_num<<31 must round-trip both fields."""
     rng = np.random.default_rng(9)
