@@ -57,7 +57,7 @@ class GcSoakReport:
             f"gc-soak: {self.steps} steps, {self.adds} adds / "
             f"{self.removes} removes, {self.joins} joins, {self.kills} kills"
             f" / {self.revivals} revivals, {self.barriers} barriers "
-            f"(+{self.barriers_noop} no-op), rows peak {self.max_rows_seen} "
+            f"({self.barriers_noop} no-op), rows peak {self.max_rows_seen} "
             f"reclaimed {self.rows_reclaimed} final {self.final_rows}, "
             f"{self.final_members} members"
         )
@@ -92,7 +92,14 @@ class _Mirror:
 
 
 class SetSoakRunner:
-    """One seeded adversarial set-workload schedule."""
+    """One seeded adversarial set-workload schedule.
+
+    NOTE: the runner skeleton (report counters, kill/revive, probability-
+    table step dispatch, barrier mirror-LUB broadcast) deliberately
+    parallels harness/seq_soak.py's SeqSoakRunner — same invariant set,
+    different lattice and mirror.  A change to the shared shape should be
+    mirrored there, or the divergence justified, like soak.py's two
+    runners."""
 
     def __init__(
         self,

@@ -1,0 +1,375 @@
+"""Sequence-workload soak: the RSeq allocator + tombstone GC under an
+adversarial concurrent-editing schedule.
+
+The round-2 RSeq redesign (variable-depth path keys, left-anchoring,
+re-anchor sweeps) and the GC floor machinery interact in ways unit tests
+can only sample: merged states change a writer's neighbours mid-run,
+barriers collect rows whose coordinates other writers may still anchor
+near, restarts must resume seq counters safely.  This runner drives N
+writer replicas (GC-wrapped RSeq states + live SeqWriter cursors) through
+a seeded random schedule of index-addressed inserts/deletes, pairwise
+gossip joins, kills/revivals, WRITER RESTARTS (cursor rebuilt from state
+with the floor-aware tomb_gc.next_seq), and GC barriers, checking after
+every action against a GC-less python mirror:
+
+  Q1 transparency  — each replica's visible list equals its mirror's
+                     (identity-sorted live elements) after every action;
+  Q2 intention     — alloc_key's internal guard raises on any misorder
+                     (left < new < right violated ⇒ the step fails);
+  Q3 no lost/resurrected edits — implied by Q1 across kill → barrier →
+                     restart → rejoin schedules;
+  Q4 reclamation   — barriers shrink tables (reported);
+  Q5 safety        — no step raises.
+
+CLI for long soaks:  python -m crdt_tpu.harness.seq_soak --steps 1000
+CI runs a short sweep (tests/test_seq_soak.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import sys
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crdt_tpu.models import rseq, tomb_gc
+from crdt_tpu.parallel import swarm
+
+AD = rseq.GC_ADAPTER
+
+
+@dataclasses.dataclass
+class SeqSoakReport:
+    steps: int = 0
+    inserts: int = 0
+    deletes: int = 0
+    joins: int = 0
+    kills: int = 0
+    revivals: int = 0
+    restarts: int = 0
+    widens: int = 0
+    barriers: int = 0
+    barriers_noop: int = 0
+    max_rows_seen: int = 0
+    rows_reclaimed: int = 0
+    final_rows: int = 0
+    final_len: int = 0
+
+    def __str__(self) -> str:
+        return (
+            f"seq-soak: {self.steps} steps, {self.inserts} ins / "
+            f"{self.deletes} del, {self.joins} joins, {self.kills} kills / "
+            f"{self.revivals} revivals, {self.restarts} restarts, "
+            f"{self.widens} widens, "
+            f"{self.barriers} barriers ({self.barriers_noop} no-op), rows "
+            f"peak {self.max_rows_seen} reclaimed {self.rows_reclaimed} "
+            f"final {self.final_rows}, len {self.final_len}"
+        )
+
+
+class _Mirror:
+    """GC-less oracle replica: identity key-row → (elem, removed).
+    The visible list is the live rows in key order — exactly what the
+    sorted table renders."""
+
+    def __init__(self):
+        self.rows: Dict[Tuple[int, ...], Tuple[int, bool]] = {}
+
+    def insert(self, key_row, elem: int) -> None:
+        self.rows[tuple(key_row)] = (elem, False)
+
+    def delete(self, key_row) -> None:
+        e, _ = self.rows[tuple(key_row)]
+        self.rows[tuple(key_row)] = (e, True)
+
+    def join(self, other: "_Mirror") -> None:
+        for k, (e, r) in other.rows.items():
+            mine = self.rows.get(k)
+            self.rows[k] = (e, r or (mine is not None and mine[1]))
+
+    def live(self) -> List[Tuple[Tuple[int, ...], int]]:
+        return sorted(
+            (k, e) for k, (e, r) in self.rows.items() if not r
+        )
+
+    def to_list(self) -> List[int]:
+        return [e for _, e in self.live()]
+
+    def copy(self) -> "_Mirror":
+        m = _Mirror()
+        m.rows = dict(self.rows)
+        return m
+
+
+class SeqSoakRunner:
+    """One seeded adversarial sequence-editing schedule.
+
+    NOTE: the runner skeleton deliberately parallels
+    harness/gc_soak.py's SetSoakRunner (see the note there): keep the
+    shared shape in sync across both."""
+
+    def __init__(
+        self,
+        n: int = 3,
+        seed: int = 0,
+        capacity: int = 512,
+        p_insert: float = 0.34,
+        p_delete: float = 0.12,
+        p_join: float = 0.22,
+        p_kill: float = 0.04,
+        p_revive: float = 0.06,
+        p_restart: float = 0.06,
+        p_barrier: float = 0.12,
+    ):
+        self.rng = random.Random(seed)
+        self.n = n
+        self.capacity = capacity
+        self.states = [
+            tomb_gc.wrap(rseq.empty(capacity), n) for _ in range(n)
+        ]
+        # one live cursor per replica; writer rid == replica index
+        self.writers = [
+            rseq.SeqWriter(self.states[i].inner, rid=i) for i in range(n)
+        ]
+        self.mirrors = [_Mirror() for _ in range(n)]
+        self.alive = [True] * n
+        self.p = (p_insert, p_delete, p_join, p_kill, p_revive,
+                  p_restart, p_barrier)
+        self.report = SeqSoakReport()
+
+    # ---- helpers ----
+
+    def _sync_writer(self, i: int) -> None:
+        """Push the Gc state's inner table into replica i's cursor."""
+        self.writers[i].state = self.states[i].inner
+
+    def _pull_writer(self, i: int) -> None:
+        """Adopt the cursor's table back into the Gc wrapper."""
+        self.states[i] = self.states[i].replace(inner=self.writers[i].state)
+
+    def _rows(self, i: int) -> int:
+        return int(rseq.n_rows(self.states[i].inner))
+
+    def _check(self, i: int, where: str) -> None:
+        got = rseq.to_list(self.states[i].inner)
+        want = self.mirrors[i].to_list()
+        assert got == want, (
+            f"Q1 transparency violated at replica {i} after {where}: "
+            f"device {got} != mirror {want}"
+        )
+
+    def _stacked(self):
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *self.states)
+
+    # ---- actions ----
+
+    def _widen_fleet(self, new_depth: int) -> None:
+        """Depth migration (rseq.widen): the recovery path for collision
+        twins identical through every level.  Host-coordinated — every
+        replica AND every mirror rekeys together (joins reject mixed
+        depths by design)."""
+        mid_hi, mid_lo = rseq.split_pos(rseq.MID)
+        self.states = [
+            g.replace(inner=rseq.widen(g.inner, new_depth))
+            for g in self.states
+        ]
+        for i in range(self.n):
+            self._sync_writer(i)
+            m = _Mirror()
+            for k, v in self.mirrors[i].rows.items():
+                levels = (4 * new_depth - len(k)) // 4
+                m.rows[k + (mid_hi, mid_lo, k[-2], k[-1]) * levels] = v
+            self.mirrors[i] = m
+        self.report.widens += 1
+
+    def _insert(self) -> None:
+        i = self.rng.randrange(self.n)
+        if not self.alive[i]:
+            return
+        if self._rows(i) >= self.capacity:
+            return  # full; only a barrier can reclaim
+        w = self.writers[i]
+        live = w._rows()
+        idx = self.rng.randint(0, len(live))
+        elem = self.report.inserts + 1
+        try:
+            w.insert_at(idx, elem)  # Q2: alloc guard raises on misorder
+        except rseq.GapExhausted:
+            # depth cap hit between deepest-level collision twins: widen
+            # the fleet and retry (the documented recovery path)
+            self._widen_fleet(self.states[i].inner.depth + 2)
+            w = self.writers[i]
+            w.insert_at(idx, elem)
+        key_row = self._new_row_of(w, elem)
+        self.mirrors[i].insert(key_row, elem)
+        self._pull_writer(i)
+        self.report.inserts += 1
+        self.report.max_rows_seen = max(
+            self.report.max_rows_seen, self._rows(i)
+        )
+        self._check(i, "insert")
+
+    def _new_row_of(self, w: rseq.SeqWriter, elem: int):
+        """The key row the cursor just allocated (by payload: elems are
+        globally unique in this harness)."""
+        keys = np.asarray(w.state.keys)
+        elems = np.asarray(w.state.elem)
+        valid = keys[:, 0] != int(rseq.SENTINEL)
+        hits = np.nonzero(valid & (elems == elem))[0]
+        assert len(hits) == 1
+        return tuple(int(x) for x in keys[hits[0]])
+
+    def _delete(self) -> None:
+        i = self.rng.randrange(self.n)
+        if not self.alive[i]:
+            return
+        w = self.writers[i]
+        live = w._rows()
+        if not live:
+            return
+        idx = self.rng.randrange(len(live))
+        key_row = live[idx]
+        w.delete_at(idx)
+        self.mirrors[i].delete(key_row)
+        self._pull_writer(i)
+        self.report.deletes += 1
+        self._check(i, "delete")
+
+    def _join(self) -> None:
+        i = self.rng.randrange(self.n)
+        j = self.rng.randrange(self.n)
+        if i == j or not (self.alive[i] and self.alive[j]):
+            return
+        out, nu = tomb_gc.join_checked(self.states[i], self.states[j], AD)
+        assert int(nu) <= self.capacity, "capacity overflow breaks GC (Q5)"
+        self.states[i] = out
+        self._sync_writer(i)
+        self.mirrors[i].join(self.mirrors[j])
+        self.report.joins += 1
+        self.report.max_rows_seen = max(
+            self.report.max_rows_seen, self._rows(i)
+        )
+        self._check(i, "join")
+
+    def _kill(self) -> None:
+        candidates = [i for i in range(self.n) if self.alive[i]]
+        if len(candidates) <= 1:
+            return
+        self.alive[self.rng.choice(candidates)] = False
+        self.report.kills += 1
+
+    def _revive(self) -> None:
+        dead = [i for i in range(self.n) if not self.alive[i]]
+        if not dead:
+            return
+        self.alive[self.rng.choice(dead)] = True
+        self.report.revivals += 1
+
+    def _restart(self) -> None:
+        """Writer-process restart: the cursor is rebuilt from the durable
+        state with the floor-aware seq resume (the tomb_gc.next_seq
+        contract under fire — a table-max resume would re-mint collected
+        identities and get silently suppressed)."""
+        i = self.rng.randrange(self.n)
+        self.writers[i] = rseq.SeqWriter(
+            self.states[i].inner, rid=i,
+            seq_start=tomb_gc.next_seq(self.states[i], AD, i),
+        )
+        self.report.restarts += 1
+        self._check(i, "restart")
+
+    def _barrier(self) -> None:
+        rows_before = sum(self._rows(i) for i in range(self.n))
+        sw = tomb_gc.gc_round(
+            swarm.make(self._stacked(), jnp.asarray(self.alive)),
+            # the neutral must track the fleet's CURRENT depth (widening
+            # migrations change the key width)
+            AD, rseq.empty(self.capacity, depth=self.states[0].inner.depth),
+        )
+        self.states = [
+            jax.tree.map(lambda x: x[i], sw.state) for i in range(self.n)
+        ]
+        lub = None
+        for i in range(self.n):
+            if self.alive[i]:
+                lub = self.mirrors[i].copy() if lub is None else lub
+                lub.join(self.mirrors[i])
+        for i in range(self.n):
+            if self.alive[i] and lub is not None:
+                self.mirrors[i] = lub.copy()
+            self._sync_writer(i)
+        rows_after = sum(self._rows(i) for i in range(self.n))
+        self.report.barriers += 1
+        if rows_after < rows_before:
+            self.report.rows_reclaimed += rows_before - rows_after
+        else:
+            self.report.barriers_noop += 1
+        for i in range(self.n):
+            self._check(i, "barrier")
+
+    # ---- run ----
+
+    def step(self) -> None:
+        ps = self.p
+        x = self.rng.random()
+        acc = 0.0
+        for p, action in zip(ps, (
+            self._insert, self._delete, self._join, self._kill,
+            self._revive, self._restart, self._barrier,
+        )):
+            acc += p
+            if x < acc:
+                action()
+                break
+        self.report.steps += 1
+
+    def heal_and_check(self) -> SeqSoakReport:
+        self.alive = [True] * self.n
+        for _ in range(self.n):
+            for i in range(self.n):
+                j = (i + 1) % self.n
+                self.states[i], _ = tomb_gc.join_checked(
+                    self.states[i], self.states[j], AD
+                )
+                self._sync_writer(i)
+                self.mirrors[i].join(self.mirrors[j])
+        lists = {tuple(rseq.to_list(self.states[i].inner))
+                 for i in range(self.n)}
+        assert len(lists) == 1, "healed swarm did not converge"
+        for i in range(self.n):
+            self._check(i, "heal")
+        self.report.final_rows = self._rows(0)
+        self.report.final_len = len(rseq.to_list(self.states[0].inner))
+        return self.report
+
+    def run(self, n_steps: int) -> SeqSoakReport:
+        for _ in range(n_steps):
+            self.step()  # Q5: no step may raise
+        return self.heal_and_check()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="RSeq + GC sequence soak")
+    ap.add_argument("--steps", type=int, default=600)
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--capacity", type=int, default=512)
+    ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu")
+    args = ap.parse_args(argv)
+    if args.platform != "ambient":
+        jax.config.update("jax_platforms", "cpu")
+    for seed in range(args.seeds):
+        runner = SeqSoakRunner(
+            n=args.replicas, seed=seed, capacity=args.capacity,
+        )
+        print(f"seed {seed}: {runner.run(args.steps)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,8 @@ payloads in row order (the table IS the list).
 """
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -203,6 +205,41 @@ def to_list(s: RSeq):
     return [int(e) for e in np.asarray(s.elem)[live]]
 
 
+@partial(jax.jit, static_argnames="new_depth")
+def widen(s: RSeq, new_depth: int) -> RSeq:
+    """Order-preserving depth migration: extend every row's path to
+    ``new_depth`` levels by appending its own (MID, rid, seq) stamp — the
+    exact stamping rule elements are born with, so lexicographic order,
+    identities, and rendered lists are all unchanged.
+
+    This is the recovery path for a depth-cap GapExhausted: collision
+    twins that are identical through all D levels leave no representable
+    slot between them at any level, only BELOW — widening adds the room.
+    Depth is shape-static, so a fleet must migrate together (join raises
+    on mismatched shapes); host-level coordination, like a capacity bump.
+    """
+    d = s.depth
+    if new_depth < d:
+        raise ValueError(f"cannot narrow depth {d} -> {new_depth}")
+    if new_depth == d:
+        return s
+    valid = s.keys[:, 0] != SENTINEL
+    own_rid = s.keys[:, -2]
+    own_seq = s.keys[:, -1]
+    mid_hi, mid_lo = split_pos(MID)
+    stamp = jnp.stack(
+        [
+            jnp.where(valid, jnp.full_like(own_rid, mid_hi), SENTINEL),
+            jnp.where(valid, jnp.full_like(own_rid, mid_lo), SENTINEL),
+            own_rid,
+            own_seq,
+        ],
+        axis=-1,
+    )
+    ext = jnp.tile(stamp, (1, new_depth - d))
+    return s.replace(keys=jnp.concatenate([s.keys, ext], axis=-1))
+
+
 # ---- tombstone GC adapter (crdt_tpu.models.tomb_gc) ----
 
 
@@ -348,7 +385,7 @@ def alloc_key(left, right, rid: int, seq: int, depth: int = DEPTH):
             else POS_MAX
         return lo, hi
 
-    def try_level(k):
+    def try_gap(k):
         lo, hi = bounds(k)
         try:
             p = _alloc_between(
@@ -360,17 +397,56 @@ def alloc_key(left, right, rid: int, seq: int, depth: int = DEPTH):
             return None
         return lt[: k - 1] + ((p, rid, seq),)
 
+    def try_escape(k):
+        """Identity-tiebreak escape: an element can sit AT a neighbour's
+        coordinate when its own (rid, seq) sorts strictly between the
+        neighbours' triples — the only representable slot between
+        same-position collision twins, and depth-free.  Never at the MID
+        stamp coordinate (depth detection relies on it)."""
+        lo, hi = bounds(k)
+        if k <= d and lo != MID and (rid, seq) > lt[k - 1][1:]:
+            if not (
+                rt is not None
+                and rt[: k - 1] == lt[: k - 1]
+                and (lo, rid, seq) >= rt[k - 1]
+            ):
+                return lt[: k - 1] + ((lo, rid, seq),)
+        if (
+            rt is not None
+            and rt[: k - 1] == lt[: k - 1]
+            and hi != POS_MAX
+            and hi != MID
+            and (rid, seq) < rt[k - 1][1:]
+            and (k > d or (hi, rid, seq) > lt[k - 1])
+        ):
+            return lt[: k - 1] + ((hi, rid, seq),)
+        return None
+
+    def gap_empty(k):
+        lo, hi = bounds(k)
+        return hi - lo < 2
+
     own = lt[d - 1][1] == rid
     protected = d >= 2 and lt[d - 2][1] == rid
-    order = []
+    candidates = []
     if own and protected:
-        order.append(d)           # sibling inside my own subtree
+        candidates.append(("gap", d))      # sibling inside my own subtree
+    # collision sites (empty integer gap) prefer the depth-free escape
+    # over descending — this is what keeps deepest-level twins insertable
+    candidates += [("esc", k) for k in range(d, 0, -1) if gap_empty(k)]
     if d + 1 <= depth:
-        order.append(d + 1)       # descend under left
-    order += [k for k in range(depth, 0, -1) if k not in order]
+        candidates.append(("gap", d + 1))  # descend under left
+    # re-anchor sweep: any gap, then any escape
+    candidates += [("gap", k) for k in range(depth, 0, -1)]
+    candidates += [("esc", k) for k in range(depth, 0, -1)]
 
-    for k in order:
-        levels = try_level(k)
+    seen = set()
+    for cand in candidates:
+        if cand in seen:
+            continue
+        seen.add(cand)
+        kind, k = cand
+        levels = try_gap(k) if kind == "gap" else try_escape(k)
         if levels is not None:
             row = _stamp(levels, rid, seq, depth)
             # intention-preservation guard: loud failure beats silent

@@ -23,7 +23,7 @@ def test_gc_soak_reclaims_under_pressure():
         n=3, seed=7, capacity=128, p_add=0.35, p_remove=0.25,
         p_join=0.2, p_kill=0.0, p_revive=0.0, p_barrier=0.2,
     ).run(400)
-    assert r.barriers >= 3
+    assert r.barriers - r.barriers_noop >= 3, "need >=3 RECLAIMING barriers"
     assert r.rows_reclaimed > 0
     assert r.final_rows < r.adds, "GC failed to bound tombstone growth"
 

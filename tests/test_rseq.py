@@ -331,6 +331,79 @@ def test_random_fuzz_converges_and_preserves_intent():
         assert len(lists) == 1, f"trial {trial} diverged"
 
 
+def test_identity_escape_between_deepest_level_twins():
+    """Collision twins identical through a level now admit an insert AT
+    the shared coordinate when the writer's identity sorts between them
+    (regression for the seq-soak GapExhausted at the depth cap)."""
+    base = rseq.SeqWriter(rseq.empty(CAP), rid=0)
+    base.append(1)
+    base.append(4)
+    a = rseq.SeqWriter(base.state, rid=1)
+    b = rseq.SeqWriter(base.state, rid=3)
+    a.insert_at(1, 2)   # same gap: same midpoint, tie-broken by rid
+    b.insert_at(1, 3)
+    merged = rseq.join(a.state, b.state)
+    # rid 2 sorts between the twins' rids 1 and 3: the escape places it
+    # at the SAME coordinate with its own identity, no extra depth
+    m = rseq.SeqWriter(merged, rid=2)
+    m.insert_at(2, 99)
+    assert m.to_list() == [1, 2, 99, 3, 4]
+    rows = m._rows()
+    depths = [rseq.real_depth(rseq._triples(r, rseq.DEPTH)) for r in rows]
+    assert max(depths) == 2  # twins at 2; 99 escaped at their level
+
+
+def test_widen_preserves_order_and_join_round_trip():
+    w = rseq.SeqWriter(rseq.empty(CAP, depth=3), rid=0)
+    for i in range(10):
+        w.insert_at(i // 2, i)
+    w.delete_at(3)
+    before = w.to_list()
+    wide = rseq.widen(w.state, 6)
+    assert wide.depth == 6
+    assert rseq.to_list(wide) == before
+    # editing and joining continue in the widened world
+    w2 = rseq.SeqWriter(wide, rid=1)
+    w2.insert_at(2, 99)
+    assert w2.to_list()[2] == 99
+    m = rseq.join(wide, w2.state)
+    assert rseq.to_list(m) == w2.to_list()
+    with pytest.raises(ValueError, match="narrow"):
+        rseq.widen(wide, 3)
+    # mixed depths must refuse to join, not silently truncate
+    with pytest.raises(ValueError, match="shapes differ"):
+        rseq.join(w.state, wide)
+
+
+def test_widen_unblocks_depth_cap_exhaustion():
+    """The exact deepest-twin scenario the seq soak found: a writer whose
+    rid sorts at-or-above BOTH twins' cannot identity-escape at the shared
+    coordinate; at the depth cap that insert is unrepresentable until
+    widen() adds a level."""
+    base = rseq.SeqWriter(rseq.empty(CAP, depth=2), rid=0)
+    base.append(1)
+    base.append(4)
+    state = base.state
+    # concurrent same-gap inserts descend under element 1 and collide at
+    # level 2 — the cap
+    a = rseq.SeqWriter(state, rid=1)
+    b = rseq.SeqWriter(state, rid=2)
+    a.insert_at(1, 10)
+    b.insert_at(1, 11)
+    state = rseq.join(a.state, b.state)
+    assert rseq.to_list(state) == [1, 10, 11, 4]
+    w = rseq.SeqWriter(state, rid=9)  # rid 9 > both twins: no escape fits
+    with pytest.raises(rseq.GapExhausted):
+        w.insert_at(2, 99)
+    wide = rseq.SeqWriter(rseq.widen(state, 4), rid=9)
+    wide.insert_at(2, 99)  # descends to level 3 in the widened table
+    assert wide.to_list() == [1, 10, 99, 11, 4]
+    # a writer whose identity DOES fit needs no widening even at the cap
+    w1 = rseq.SeqWriter(state, rid=1)
+    w1.insert_at(2, 55)
+    assert w1.to_list() == [1, 10, 55, 11, 4]
+
+
 def test_seqwriter_restart_does_not_remint_identities():
     """A restarted writer (default seq_start) must resume ABOVE its own
     largest in-table seq — re-minting a used (rid, seq) would collide two

@@ -165,6 +165,32 @@ def test_gc_join_laws_on_simulated_history():
     assert tree_equal(_join(a, a), a)
 
 
+def test_gc_and_columnar_states_checkpoint_roundtrip(tmp_path):
+    """The generic swarm snapshot path must cover the round-2 lattices:
+    GC-wrapped OR-Sets (floor plane included) and the columnar OpLog
+    (static bits restored from the template)."""
+    from crdt_tpu.models import oplog, oplog_columnar as oc
+    from crdt_tpu.utils import checkpoint
+    from tests.helpers import tree_equal
+
+    g = tomb_gc.wrap(orset.empty(16), W)
+    g = _add(g, 5, 1, 0)
+    g = tomb_gc.collect(g, jnp.asarray([-1, 0, -1, -1], jnp.int32), AD)
+    checkpoint.save_swarm(str(tmp_path / "gc"), g)
+    back = checkpoint.restore_swarm(
+        str(tmp_path / "gc"), tomb_gc.wrap(orset.empty(16), W)
+    )
+    assert tree_equal(back, g)
+
+    logs = [oplog.empty(8) for _ in range(2)]
+    col = oc.stack(jax.tree.map(lambda *xs: jnp.stack(xs), *logs),
+                   bits=(4, 22, 5))
+    checkpoint.save_swarm(str(tmp_path / "col"), col)
+    back = checkpoint.restore_swarm(str(tmp_path / "col"), col)
+    assert back.bits == (4, 22, 5)
+    assert tree_equal(back, col)
+
+
 def test_next_seq_is_floor_aware():
     """After GC collects a writer's rows, the table max understates the used
     seq range; next_seq must resume above the floor instead."""
