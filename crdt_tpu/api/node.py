@@ -673,6 +673,7 @@ class ReplicaNode:
         return fresh
 
     def _grow(self) -> None:
-        bigger = oplog.empty(self.log.capacity * 2)
-        self.log = oplog.merge(bigger, self.log)
+        # tail-pad capacity doubling (oplog.grow is O(n) and lossless —
+        # the old merge-into-bigger-empty paid a full sorted union here)
+        self.log = oplog.grow(self.log, self.log.capacity * 2)
         self.metrics.inc("log_grow")

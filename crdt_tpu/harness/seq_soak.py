@@ -274,6 +274,8 @@ class SeqSoakRunner:
         contract under fire — a table-max resume would re-mint collected
         identities and get silently suppressed)."""
         i = self.rng.randrange(self.n)
+        if not self.alive[i]:
+            return  # dead processes don't restart cursors (fault model)
         self.writers[i] = rseq.SeqWriter(
             self.states[i].inner, rid=i,
             seq_start=tomb_gc.next_seq(self.states[i], AD, i),

@@ -117,6 +117,27 @@ def from_ops(capacity: int, ops: Mapping[str, jax.Array]) -> OpLog:
                  val=out[4], payload=out[5], is_num=out[6])
 
 
+@partial(jax.jit, static_argnames="new_capacity")
+def grow(log: OpLog, new_capacity: int) -> OpLog:
+    """Capacity migration: append tail padding (rows are sorted with
+    padding last, so contents and merge results are unchanged).  The host
+    layer's overflow recovery (api.node._grow) doubles capacity with this
+    before its checked ingest merge."""
+    pad = new_capacity - log.capacity
+    if pad < 0:
+        raise ValueError(f"cannot shrink capacity {log.capacity} -> {new_capacity}")
+
+    def key_col(c):
+        return jnp.pad(c, (0, pad), constant_values=int(SENTINEL))
+
+    return OpLog(
+        ts=key_col(log.ts), rid=key_col(log.rid), seq=key_col(log.seq),
+        key=key_col(log.key),
+        val=jnp.pad(log.val, (0, pad)), payload=jnp.pad(log.payload, (0, pad)),
+        is_num=jnp.pad(log.is_num, (0, pad)),
+    )
+
+
 @jax.jit
 def merge(local: OpLog, remote: OpLog) -> OpLog:
     """CRDT join: union of the two logs keyed by (ts, rid, seq, key).

@@ -185,6 +185,27 @@ def unstack(col: ColumnarOpLog) -> oplog.OpLog:
     )
 
 
+@partial(jax.jit, static_argnames="new_capacity")
+def grow(col: ColumnarOpLog, new_capacity: int) -> ColumnarOpLog:
+    """Capacity migration in the columnar layout: append tail padding
+    ROWS (per-lane sorted order keeps padding last).  new_capacity must
+    stay a power of two (the kernel's bitonic network requires it)."""
+    pad = new_capacity - col.capacity
+    if pad < 0:
+        raise ValueError(
+            f"cannot shrink capacity {col.capacity} -> {new_capacity}"
+        )
+    if new_capacity & (new_capacity - 1):
+        raise ValueError(f"capacity {new_capacity} must be a power of two")
+    return ColumnarOpLog(
+        hi=jnp.pad(col.hi, ((0, pad), (0, 0)), constant_values=int(SENTINEL)),
+        lo=jnp.pad(col.lo, ((0, pad), (0, 0)), constant_values=int(SENTINEL)),
+        val=jnp.pad(col.val, ((0, pad), (0, 0))),
+        pay=jnp.pad(col.pay, ((0, pad), (0, 0))),
+        bits=col.bits,
+    )
+
+
 def _pad_lanes(col: ColumnarOpLog, lanes: int) -> ColumnarOpLog:
     pad = lanes - col.lanes
     if pad == 0:

@@ -114,6 +114,23 @@ def _resort(s: ORSet):
     return out[:3], out[3]
 
 
+@partial(jax.jit, static_argnames="new_capacity")
+def grow(s: ORSet, new_capacity: int) -> ORSet:
+    """Capacity migration: rows are sorted with padding at the tail, so
+    growth is just more tail padding — contents, order, and join results
+    are unchanged.  Joins require equal capacities (the union's out_size
+    is the left side's), so fleets migrate together, like rseq.widen."""
+    pad = new_capacity - s.capacity
+    if pad < 0:
+        raise ValueError(f"cannot shrink capacity {s.capacity} -> {new_capacity}")
+    return ORSet(
+        elem=jnp.pad(s.elem, (0, pad), constant_values=int(SENTINEL)),
+        rid=jnp.pad(s.rid, (0, pad), constant_values=int(SENTINEL)),
+        seq=jnp.pad(s.seq, (0, pad), constant_values=int(SENTINEL)),
+        removed=jnp.pad(s.removed, (0, pad)),
+    )
+
+
 # ---- tombstone GC adapter (crdt_tpu.models.tomb_gc) ----
 
 

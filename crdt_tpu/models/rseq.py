@@ -205,6 +205,22 @@ def to_list(s: RSeq):
     return [int(e) for e in np.asarray(s.elem)[live]]
 
 
+@partial(jax.jit, static_argnames="new_capacity")
+def grow(s: RSeq, new_capacity: int) -> RSeq:
+    """Capacity migration (the recovery path for CapacityExceeded): rows
+    are sorted with padding at the tail, so growth is just more tail
+    padding.  Like widen, fleets migrate together — joins reject
+    mismatched shapes."""
+    pad = new_capacity - s.capacity
+    if pad < 0:
+        raise ValueError(f"cannot shrink capacity {s.capacity} -> {new_capacity}")
+    return RSeq(
+        keys=jnp.pad(s.keys, ((0, pad), (0, 0)), constant_values=int(SENTINEL)),
+        elem=jnp.pad(s.elem, (0, pad)),
+        removed=jnp.pad(s.removed, (0, pad)),
+    )
+
+
 @partial(jax.jit, static_argnames="new_depth")
 def widen(s: RSeq, new_depth: int) -> RSeq:
     """Order-preserving depth migration: extend every row's path to
@@ -533,9 +549,11 @@ class SeqWriter:
         right = (
             self._row(keys, live_idx[index]) if index < len(live_idx) else None
         )
-        seq = self._seq
+        # mint the seq only AFTER allocation succeeds: a GapExhausted here
+        # (recovered via widen + retry) must not burn a seq — per-writer
+        # contiguity is a documented tomb_gc invariant
+        key = alloc_key(left, right, self.rid, self._seq, self.state.depth)
         self._seq += 1
-        key = alloc_key(left, right, self.rid, seq, self.state.depth)
         self.state = insert(self.state, key, elem)
 
     def append(self, elem: int) -> None:

@@ -28,10 +28,12 @@ def test_gc_soak_reclaims_under_pressure():
     assert r.final_rows < r.adds, "GC failed to bound tombstone growth"
 
 
-def test_gc_soak_long():
+def test_gc_soak_long(request):
     import os
 
-    if not os.environ.get("CRDT_LONG"):
-        pytest.skip("long soak: set CRDT_LONG=1 (or pytest --long)")
+    # --long (conftest) or CRDT_LONG both enable it, like the other
+    # long-mode suites (tests/test_parity_fuzz.py)
+    if not (request.config.getoption("--long") or os.environ.get("CRDT_LONG")):
+        pytest.skip("long soak: pytest --long (or CRDT_LONG=1)")
     for seed in range(10):
         SetSoakRunner(n=5, seed=seed, capacity=1024).run(1500)

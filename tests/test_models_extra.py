@@ -278,3 +278,64 @@ def test_tpset_overflow_checked():
     b = gset.tp_add(gset.tp_empty(4), 99)
     _, n = gset.tp_join_checked(a, b)
     assert int(n) == 5  # true union exceeds capacity: detectable host-side
+
+# ---- capacity growth migrations (round 2: grow/widen family) ----
+
+
+def test_grow_preserves_state_and_joins():
+    """grow() = tail padding on every table lattice: contents, order, and
+    join results unchanged; shrink refused."""
+    import jax.numpy as jnp
+
+    from crdt_tpu.models import oplog, orset, rseq
+    from tests.helpers import tree_equal
+
+    s = orset.empty(8)
+    for i in range(5):
+        s = orset.add(s, i, 0, i)
+    s = orset.remove(s, 2)
+    g = orset.grow(s, 16)
+    assert g.capacity == 16
+    assert np.asarray(orset.member_mask(g, 8)).tolist() == \
+        np.asarray(orset.member_mask(s, 8)).tolist()
+    # joins at the grown capacity keep working (both sides migrated)
+    j = orset.join(g, orset.grow(s, 16))
+    assert tree_equal(j, g)
+    with pytest.raises(ValueError, match="shrink"):
+        orset.grow(s, 4)
+
+    w = rseq.SeqWriter(rseq.empty(4), rid=0)
+    for i in range(4):
+        w.append(i)
+    with pytest.raises(rseq.CapacityExceeded):
+        w.append(9)
+    w2 = rseq.SeqWriter(rseq.grow(w.state, 8), rid=0)  # the recovery path
+    w2.append(9)
+    assert w2.to_list() == [0, 1, 2, 3, 9]
+
+    log = oplog.from_ops(4, {
+        "ts": jnp.asarray([1, 2], jnp.int32),
+        "rid": jnp.asarray([0, 0], jnp.int32),
+        "seq": jnp.asarray([0, 1], jnp.int32),
+        "key": jnp.asarray([0, 1], jnp.int32),
+        "val": jnp.asarray([5, -3], jnp.int32),
+        "payload": jnp.asarray([0, 0], jnp.int32),
+        "is_num": jnp.asarray([True, True]),
+    })
+    big = oplog.grow(log, 16)
+    assert int(oplog.size(big)) == 2
+    kv_a = oplog.rebuild(log, 4)
+    kv_b = oplog.rebuild(big, 4)
+    np.testing.assert_array_equal(np.asarray(kv_a.num), np.asarray(kv_b.num))
+
+
+def test_grow_columnar_requires_power_of_two():
+    from crdt_tpu.models import oplog_columnar as oc
+
+    col = oc.empty(8, 4)
+    g = oc.grow(col, 16)
+    assert g.capacity == 16 and g.lanes == 4
+    with pytest.raises(ValueError, match="power of two"):
+        oc.grow(col, 12)
+    with pytest.raises(ValueError, match="shrink"):
+        oc.grow(col, 4)

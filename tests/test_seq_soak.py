@@ -28,10 +28,12 @@ def test_seq_soak_exercises_gc_and_restarts():
     assert r.inserts - r.deletes <= r.final_len < r.inserts
 
 
-def test_seq_soak_long():
+def test_seq_soak_long(request):
     import os
 
-    if not os.environ.get("CRDT_LONG"):
-        pytest.skip("long soak: set CRDT_LONG=1 (or pytest --long)")
+    # --long (conftest) or CRDT_LONG both enable it, like the other
+    # long-mode suites (tests/test_parity_fuzz.py)
+    if not (request.config.getoption("--long") or os.environ.get("CRDT_LONG")):
+        pytest.skip("long soak: pytest --long (or CRDT_LONG=1)")
     for seed in range(6):
         SeqSoakRunner(n=4, seed=seed, capacity=1024).run(1000)
