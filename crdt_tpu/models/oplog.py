@@ -123,19 +123,11 @@ def grow(log: OpLog, new_capacity: int) -> OpLog:
     padding last, so contents and merge results are unchanged).  The host
     layer's overflow recovery (api.node._grow) doubles capacity with this
     before its checked ingest merge."""
-    pad = new_capacity - log.capacity
-    if pad < 0:
+    from crdt_tpu.utils.tables import grow_into
+
+    if new_capacity < log.capacity:
         raise ValueError(f"cannot shrink capacity {log.capacity} -> {new_capacity}")
-
-    def key_col(c):
-        return jnp.pad(c, (0, pad), constant_values=int(SENTINEL))
-
-    return OpLog(
-        ts=key_col(log.ts), rid=key_col(log.rid), seq=key_col(log.seq),
-        key=key_col(log.key),
-        val=jnp.pad(log.val, (0, pad)), payload=jnp.pad(log.payload, (0, pad)),
-        is_num=jnp.pad(log.is_num, (0, pad)),
-    )
+    return grow_into(log, empty(new_capacity))
 
 
 @jax.jit

@@ -190,20 +190,15 @@ def grow(col: ColumnarOpLog, new_capacity: int) -> ColumnarOpLog:
     """Capacity migration in the columnar layout: append tail padding
     ROWS (per-lane sorted order keeps padding last).  new_capacity must
     stay a power of two (the kernel's bitonic network requires it)."""
-    pad = new_capacity - col.capacity
-    if pad < 0:
+    from crdt_tpu.utils.tables import grow_into
+
+    if new_capacity < col.capacity:
         raise ValueError(
             f"cannot shrink capacity {col.capacity} -> {new_capacity}"
         )
     if new_capacity & (new_capacity - 1):
         raise ValueError(f"capacity {new_capacity} must be a power of two")
-    return ColumnarOpLog(
-        hi=jnp.pad(col.hi, ((0, pad), (0, 0)), constant_values=int(SENTINEL)),
-        lo=jnp.pad(col.lo, ((0, pad), (0, 0)), constant_values=int(SENTINEL)),
-        val=jnp.pad(col.val, ((0, pad), (0, 0))),
-        pay=jnp.pad(col.pay, ((0, pad), (0, 0))),
-        bits=col.bits,
-    )
+    return grow_into(col, empty(new_capacity, col.lanes, col.bits))
 
 
 def _pad_lanes(col: ColumnarOpLog, lanes: int) -> ColumnarOpLog:
@@ -313,15 +308,18 @@ def converge_checked(
     state.  Returns (ColumnarOpLog, max_n_unique): max_n_unique > capacity
     means some pairwise union overflowed (newest ops dropped) — the same
     silent-truncation contract as the generic path, made checkable."""
+    from crdt_tpu.utils.tracing import trace_region
+
     lanes = col.lanes
-    work, max_nu = lub_lane(col, alive, interpret=interpret)
-    top = jax.tree.map(
-        lambda x: jnp.broadcast_to(x[:, :1], (col.capacity, lanes)), work
-    )
-    if alive is not None:
-        a = alive[None, :]
-        top = jax.tree.map(lambda t, x: jnp.where(a, t, x), top, col)
-    return top, max_nu
+    with trace_region("oplog_columnar.converge"):
+        work, max_nu = lub_lane(col, alive, interpret=interpret)
+        top = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[:, :1], (col.capacity, lanes)), work
+        )
+        if alive is not None:
+            a = alive[None, :]
+            top = jax.tree.map(lambda t, x: jnp.where(a, t, x), top, col)
+        return top, max_nu
 
 
 def converge(

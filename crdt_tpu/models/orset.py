@@ -120,15 +120,11 @@ def grow(s: ORSet, new_capacity: int) -> ORSet:
     growth is just more tail padding — contents, order, and join results
     are unchanged.  Joins require equal capacities (the union's out_size
     is the left side's), so fleets migrate together, like rseq.widen."""
-    pad = new_capacity - s.capacity
-    if pad < 0:
+    from crdt_tpu.utils.tables import grow_into
+
+    if new_capacity < s.capacity:
         raise ValueError(f"cannot shrink capacity {s.capacity} -> {new_capacity}")
-    return ORSet(
-        elem=jnp.pad(s.elem, (0, pad), constant_values=int(SENTINEL)),
-        rid=jnp.pad(s.rid, (0, pad), constant_values=int(SENTINEL)),
-        seq=jnp.pad(s.seq, (0, pad), constant_values=int(SENTINEL)),
-        removed=jnp.pad(s.removed, (0, pad)),
-    )
+    return grow_into(s, empty(new_capacity))
 
 
 # ---- tombstone GC adapter (crdt_tpu.models.tomb_gc) ----

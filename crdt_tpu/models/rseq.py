@@ -211,14 +211,11 @@ def grow(s: RSeq, new_capacity: int) -> RSeq:
     are sorted with padding at the tail, so growth is just more tail
     padding.  Like widen, fleets migrate together — joins reject
     mismatched shapes."""
-    pad = new_capacity - s.capacity
-    if pad < 0:
+    from crdt_tpu.utils.tables import grow_into
+
+    if new_capacity < s.capacity:
         raise ValueError(f"cannot shrink capacity {s.capacity} -> {new_capacity}")
-    return RSeq(
-        keys=jnp.pad(s.keys, ((0, pad), (0, 0)), constant_values=int(SENTINEL)),
-        elem=jnp.pad(s.elem, (0, pad)),
-        removed=jnp.pad(s.removed, (0, pad)),
-    )
+    return grow_into(s, empty(new_capacity, s.depth))
 
 
 @partial(jax.jit, static_argnames="new_depth")

@@ -202,36 +202,39 @@ def gc_round(sw, adapter, neutral_inner):
     silently-dropped rows (see GcOverflow)."""
     from crdt_tpu.ops import joins as joins_mod
     from crdt_tpu.parallel import swarm as swarm_mod
+    from crdt_tpu.utils.tracing import trace_region
 
     neutral = wrap(neutral_inner, sw.state.floor.shape[-1])
     jbc = jax.vmap(lambda x, y: join_checked(x, y, adapter))
 
-    # converge (alive LUB + broadcast) with overflow tracking: the same
-    # log-depth tree reduction joins.tree_reduce_join runs, unrolled here
-    # so each level's n_unique is observable host-side
-    state = joins_mod.pad_to_pow2(
-        swarm_mod.mask_dead_with_neutral(sw.state, sw.alive, neutral), neutral
-    )
-    cap = adapter.capacity_of(neutral_inner)
-    max_nu = 0
-    p = jax.tree.leaves(state)[0].shape[0]
-    while p > 1:
-        p //= 2
-        lo = jax.tree.map(lambda x: x[:p], state)
-        hi = jax.tree.map(lambda x: x[p : 2 * p], state)
-        state, nu = jbc(lo, hi)
-        max_nu = max(max_nu, int(nu.max()))
-    if max_nu > cap:
-        raise GcOverflow(
-            f"GC barrier union needs {max_nu} rows but capacity is {cap}"
+    with trace_region("tomb_gc.barrier"):
+        # converge (alive LUB + broadcast) with overflow tracking: the same
+        # log-depth tree reduction joins.tree_reduce_join runs, unrolled
+        # here so each level's n_unique is observable host-side
+        state = joins_mod.pad_to_pow2(
+            swarm_mod.mask_dead_with_neutral(sw.state, sw.alive, neutral),
+            neutral,
         )
-    top = jax.tree.map(lambda x: x[0], state)
-    sw = sw.replace(
-        state=swarm_mod.broadcast_where_alive(sw.state, sw.alive, top)
-    )
-    return swarm_mod.compaction_round(
-        sw,
-        received_vv=lambda st: received_vv(st, adapter),
-        compact=lambda st, f: collect(st, f, adapter),
-        frontier_of=lambda st: st.floor,
-    )
+        cap = adapter.capacity_of(neutral_inner)
+        max_nu = 0
+        p = jax.tree.leaves(state)[0].shape[0]
+        while p > 1:
+            p //= 2
+            lo = jax.tree.map(lambda x: x[:p], state)
+            hi = jax.tree.map(lambda x: x[p : 2 * p], state)
+            state, nu = jbc(lo, hi)
+            max_nu = max(max_nu, int(nu.max()))
+        if max_nu > cap:
+            raise GcOverflow(
+                f"GC barrier union needs {max_nu} rows but capacity is {cap}"
+            )
+        top = jax.tree.map(lambda x: x[0], state)
+        sw = sw.replace(
+            state=swarm_mod.broadcast_where_alive(sw.state, sw.alive, top)
+        )
+        return swarm_mod.compaction_round(
+            sw,
+            received_vv=lambda st: received_vv(st, adapter),
+            compact=lambda st, f: collect(st, f, adapter),
+            frontier_of=lambda st: st.floor,
+        )

@@ -1,0 +1,25 @@
+"""Shared table-lattice migration helper.
+
+Every sorted-table lattice (oplog, orset, rseq, oplog_columnar) keeps its
+padding rows at the tail, so capacity growth is "place the old state at
+the head of a bigger empty" — expressed here once, so each module's
+``grow()`` can never drift from its own ``empty()`` padding conventions
+(the join invariant that padding sorts last lives in one place)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def grow_into(state: Any, bigger_empty: Any) -> Any:
+    """Copy ``state``'s leaves into the head of ``bigger_empty``'s (a
+    freshly built empty of the larger capacity; same pytree structure,
+    each leaf at least as large in every dimension)."""
+    return jax.tree.map(
+        lambda old, new: jax.lax.dynamic_update_slice(
+            new, old.astype(new.dtype), (0,) * old.ndim
+        ),
+        state,
+        bigger_empty,
+    )

@@ -111,6 +111,59 @@ def test_fused_matches_unfused(c):
                 np.asarray(f), np.asarray(r), err_msg=f"{name} out={out}")
 
 
+def _lex2_cols(rng, c, lanes, hi_max, n_vals):
+    """Per-lane sorted unique (hi, lo) pairs + n value planes."""
+    hi = np.full((c, lanes), SENTINEL_PY, np.int32)
+    lo = np.full((c, lanes), SENTINEL_PY, np.int32)
+    vals = [np.zeros((c, lanes), np.int32) for _ in range(n_vals)]
+    for j in range(lanes):
+        n = int(rng.integers(0, c + 1))
+        pairs = sorted(
+            {(int(rng.integers(0, hi_max)), int(rng.integers(0, 4)))
+             for _ in range(n)}
+        )
+        for r, (h, l) in enumerate(pairs):
+            hi[r, j], lo[r, j] = h, l
+            for v in vals:
+                v[r, j] = h * 131 + l * 7 + 1  # value determined by key
+    return jnp.asarray(hi), jnp.asarray(lo), [jnp.asarray(v) for v in vals]
+
+
+@pytest.mark.parametrize("n_vals,out_mode", [(1, "cap"), (2, "full"), (3, "cap")])
+def test_lex2_union_matches_generic(n_vals, out_mode):
+    """The two-word lexicographic fused kernel must agree with the generic
+    sorted_union on every plane, at both the capacity-bounded and the
+    lossless (2C) output sizes, for any number of value planes.  Values are
+    key-determined so the keep-first duplicate rule is well-posed."""
+    from crdt_tpu.ops import sorted_union as su
+
+    rng = np.random.default_rng(17 * n_vals)
+    c, lanes = 16, 128
+    ha, la, va = _lex2_cols(rng, c, lanes, hi_max=24, n_vals=n_vals)
+    hb, lb, vb = _lex2_cols(rng, c, lanes, hi_max=24, n_vals=n_vals)
+    out = c if out_mode == "cap" else 2 * c
+    (ho, lo_), vo, nu = pallas_union.sorted_union_columnar_fused_lex2(
+        (ha, la), tuple(va), (hb, lb), tuple(vb), out_size=out,
+        interpret=True,
+    )
+    for j in range(0, lanes, 13):
+        keys, vals, n = su.sorted_union(
+            (ha[:, j], la[:, j]),
+            {i: v[:, j] for i, v in enumerate(va)},
+            (hb[:, j], lb[:, j]),
+            {i: v[:, j] for i, v in enumerate(vb)},
+            combine=su.keep_first,
+            out_size=out,
+        )
+        np.testing.assert_array_equal(np.asarray(keys[0]), np.asarray(ho[:, j]))
+        np.testing.assert_array_equal(np.asarray(keys[1]), np.asarray(lo_[:, j]))
+        for i in range(n_vals):
+            np.testing.assert_array_equal(
+                np.asarray(vals[i]), np.asarray(vo[i][:, j]), err_msg=f"val {i}"
+            )
+        assert int(n) == int(nu[j])
+
+
 def test_fused_empty_and_degenerate():
     c, lanes = 16, 128
     empty_k = jnp.full((c, lanes), SENTINEL_PY, jnp.int32)
