@@ -52,3 +52,29 @@ def test_shard_host_local_generic_lattice():
 
 def test_process_span_single():
     assert multihost.process_span() == (0, 1)
+
+
+def test_columnar_sharded_converge_on_global_mesh():
+    """The fused-kernel sharded convergence runs over the multi-host
+    global mesh unchanged (same shard_map + collectives; interpret-pallas
+    on the CPU mesh, compiled Mosaic on TPU)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crdt_tpu.models import oplog_columnar as oc
+
+    from tests.test_oplog_columnar import BITS, _op_pool, _random_batch
+
+    m = multihost.global_mesh()
+    rng = np.random.default_rng(0)
+    c, r = 16, 16
+    # lanes must hold subsets of a SHARED op pool: identical identities
+    # carry identical payloads (the op-identity invariant every merge
+    # path assumes)
+    col = oc.stack(_random_batch(rng, r, c, _op_pool(rng, 12)), bits=BITS)
+    sharded = jax.device_put(col, NamedSharding(m, P(None, "replica")))
+    step = oc.sharded_converge(m, bits=col.bits)
+    out, nu = step(sharded, jnp.ones((r,), bool))
+    want = oc.converge(col, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out.hi), np.asarray(want.hi))
+    np.testing.assert_array_equal(np.asarray(out.val), np.asarray(want.val))
+    assert int(nu) <= c

@@ -112,3 +112,32 @@ def test_swarm_converge():
         row = jax.tree.map(lambda x: x[i], s.state)
         assert bool(ormap.contains(row)[0])
         assert int(pncounter.value(ormap.get(row, 0))) == 3
+
+
+def test_orset_valued_map_composes():
+    """The map composes ANY value lattice — including the sorted-table
+    OR-Set: per-key element sets with observed-remove keys on top."""
+    from crdt_tpu.models import orset
+
+    or_join = ormap.joiner(jax.vmap(orset.join))
+    zero = orset.empty(8)
+    a = ormap.empty(K, W, zero)
+    b = ormap.empty(K, W, zero)
+    # writer 0 adds {5, 6} under key 2 on a; writer 1 adds {6, 7} on b
+    a = ormap.update(a, 2, 0, lambda s: orset.add(orset.add(s, 5, 0, 0), 6, 0, 1))
+    b = ormap.update(b, 2, 1, lambda s: orset.add(orset.add(s, 6, 1, 0), 7, 1, 1))
+    m1 = or_join(a, b)
+    m2 = or_join(b, a)
+    assert tree_equal(m1, m2)
+    assert bool(ormap.contains(m1)[2])
+    members = np.nonzero(np.asarray(orset.member_mask(ormap.get(m1, 2), 10)))[0]
+    assert members.tolist() == [5, 6, 7]
+    # remove the KEY on a (observed-remove): b's concurrent update survives
+    a2 = ormap.remove(m1, 2, 0)
+    b2 = ormap.update(m1, 2, 1, lambda s: orset.add(s, 9, 1, 2))
+    m3 = or_join(a2, b2)
+    assert bool(ormap.contains(m3)[2]), "concurrent update keeps key alive"
+    # and removing an ELEMENT inside the value set tombstones it
+    m4 = ormap.update(m3, 2, 1, lambda s: orset.remove(s, 6))
+    members = np.nonzero(np.asarray(orset.member_mask(ormap.get(m4, 2), 10)))[0]
+    assert 6 not in members.tolist() and 9 in members.tolist()

@@ -191,6 +191,25 @@ def test_gc_and_columnar_states_checkpoint_roundtrip(tmp_path):
     assert tree_equal(back, col)
 
 
+def test_gc_barrier_refuses_on_overflow():
+    """A barrier whose converge-union would truncate must raise GcOverflow
+    instead of advancing the floor over silently-dropped rows; growing the
+    fleet first (orset.grow) is the recovery path."""
+    small = 8
+    a = tomb_gc.wrap(orset.empty(small), W)
+    b = tomb_gc.wrap(orset.empty(small), W)
+    for i in range(6):
+        a = _add(a, i, 0, i)          # disjoint tag sets: union = 12 > 8
+        b = b.replace(inner=orset.add(b.inner, 10 + i, 1, i))
+    sw = swarm.make(_stack([a, b]))
+    with pytest.raises(tomb_gc.GcOverflow, match="12 rows"):
+        tomb_gc.gc_round(sw, AD, orset.empty(small))
+    grown = [g.replace(inner=orset.grow(g.inner, 16)) for g in (a, b)]
+    sw2 = tomb_gc.gc_round(swarm.make(_stack(grown)), AD, orset.empty(16))
+    g2 = _unstack(sw2.state, 2)[0]
+    assert int(orset.size(g2.inner)) == 12  # all live, nothing collected
+
+
 def test_next_seq_is_floor_aware():
     """After GC collects a writer's rows, the table max understates the used
     seq range; next_seq must resume above the floor instead."""
