@@ -116,7 +116,8 @@ class SeqSoakRunner:
         n: int = 3,
         seed: int = 0,
         capacity: int = 512,
-        p_insert: float = 0.34,
+        p_insert: float = 0.28,
+        p_run: float = 0.06,
         p_delete: float = 0.12,
         p_join: float = 0.22,
         p_kill: float = 0.04,
@@ -136,7 +137,7 @@ class SeqSoakRunner:
         ]
         self.mirrors = [_Mirror() for _ in range(n)]
         self.alive = [True] * n
-        self.p = (p_insert, p_delete, p_join, p_kill, p_revive,
+        self.p = (p_insert, p_run, p_delete, p_join, p_kill, p_revive,
                   p_restart, p_barrier)
         self.report = SeqSoakReport()
 
@@ -185,32 +186,49 @@ class SeqSoakRunner:
             self.mirrors[i] = m
         self.report.widens += 1
 
-    def _insert(self) -> None:
+    def _do_insert(self, length: int, where: str) -> None:
+        """Shared insert scaffold: replica pick, capacity gate, the
+        GapExhausted widen-and-retry recovery, mirror + report updates.
+        length == 1 edits through insert_at; longer runs through the
+        batched single-union insert_run — same invariants either way."""
         i = self.rng.randrange(self.n)
         if not self.alive[i]:
             return
-        if self._rows(i) >= self.capacity:
+        if self._rows(i) + length > self.capacity:
             return  # full; only a barrier can reclaim
         w = self.writers[i]
         live = w._rows()
         idx = self.rng.randint(0, len(live))
-        elem = self.report.inserts + 1
+        elems = [self.report.inserts + 1 + k for k in range(length)]
+
+        def edit(writer):
+            if length == 1:
+                writer.insert_at(idx, elems[0])  # Q2: alloc guard inside
+            else:
+                writer.insert_run(idx, elems)
+
         try:
-            w.insert_at(idx, elem)  # Q2: alloc guard raises on misorder
+            edit(w)
         except rseq.GapExhausted:
             # depth cap hit between deepest-level collision twins: widen
             # the fleet and retry (the documented recovery path)
             self._widen_fleet(self.states[i].inner.depth + 2)
             w = self.writers[i]
-            w.insert_at(idx, elem)
-        key_row = self._new_row_of(w, elem)
-        self.mirrors[i].insert(key_row, elem)
+            edit(w)
+        for e in elems:
+            self.mirrors[i].insert(self._new_row_of(w, e), e)
         self._pull_writer(i)
-        self.report.inserts += 1
+        self.report.inserts += length
         self.report.max_rows_seen = max(
             self.report.max_rows_seen, self._rows(i)
         )
-        self._check(i, "insert")
+        self._check(i, where)
+
+    def _insert(self) -> None:
+        self._do_insert(1, "insert")
+
+    def _insert_run(self) -> None:
+        self._do_insert(self.rng.randint(2, 5), "insert_run")
 
     def _new_row_of(self, w: rseq.SeqWriter, elem: int):
         """The key row the cursor just allocated (by payload: elems are
@@ -319,8 +337,8 @@ class SeqSoakRunner:
         x = self.rng.random()
         acc = 0.0
         for p, action in zip(ps, (
-            self._insert, self._delete, self._join, self._kill,
-            self._revive, self._restart, self._barrier,
+            self._insert, self._insert_run, self._delete, self._join,
+            self._kill, self._revive, self._restart, self._barrier,
         )):
             acc += p
             if x < acc:

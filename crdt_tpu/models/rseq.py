@@ -167,24 +167,37 @@ def join_checked(a: RSeq, b: RSeq):
     return _from_union(keys, vals), n
 
 
-@jax.jit
 def insert(s: RSeq, key, elem) -> RSeq:
     """Insert one identified element (the flattened ``key`` row is allocated
     host-side by SeqWriter/alloc_key).  Requires a free slot — callers
-    (SeqWriter) check capacity host-side and raise CapacityExceeded."""
-    key = jnp.asarray(key, jnp.int32).reshape(1, -1)
-    if key.shape[-1] != s.keys.shape[-1]:
+    (SeqWriter) check capacity host-side and raise CapacityExceeded.
+    The length-1 case of insert_batch."""
+    return insert_batch(
+        s, jnp.asarray(key, jnp.int32).reshape(1, -1), [elem]
+    )
+
+
+@jax.jit
+def insert_batch(s: RSeq, key_rows, elems) -> RSeq:
+    """Insert a pre-allocated RUN of elements in one union (the device
+    cost of a whole typing run collapses to a single sorted union).
+    ``key_rows``: int32[N, 4*D]; all-SENTINEL rows are padding (how
+    SeqWriter.insert_run pads run lengths to powers of two, bounding jit
+    retraces to O(log max_run) — N is a static trace dimension)."""
+    key_rows = jnp.asarray(key_rows, jnp.int32)
+    if key_rows.shape[-1] != s.keys.shape[-1]:
         raise ValueError(
-            f"key row has {key.shape[-1]} columns, state expects "
+            f"key rows have {key_rows.shape[-1]} columns, state expects "
             f"{s.keys.shape[-1]} (depth mismatch)"
         )
-    one = RSeq(
-        keys=key,
-        elem=jnp.full((1,), elem, jnp.int32),
-        removed=jnp.zeros((1,), bool),
+    n = key_rows.shape[0]
+    batch = RSeq(
+        keys=key_rows,
+        elem=jnp.asarray(elems, jnp.int32).reshape(n),
+        removed=jnp.zeros((n,), bool),
     )
     keys, vals, _ = su.sorted_union(
-        _key_cols(s), _vals(s), _key_cols(one), _vals(one),
+        _key_cols(s), _vals(s), _key_cols(batch), _vals(batch),
         combine=_combine, out_size=s.capacity,
     )
     return _from_union(keys, vals)
@@ -555,6 +568,45 @@ class SeqWriter:
 
     def append(self, elem: int) -> None:
         self.insert_at(None, elem)
+
+    def insert_run(self, index: int | None, elems) -> None:
+        """Insert a left-to-right run before ``index`` (None = append) in
+        ONE device union: all position keys allocate host-side first (each
+        chained after the previous, exactly like typing), and the seq
+        counter commits only after every allocation succeeds — a
+        GapExhausted mid-run burns nothing (widen and retry)."""
+        elems = list(elems)
+        if not elems:
+            return
+        keys, occupied, live_idx = self._snapshot()
+        if int(occupied.sum()) + len(elems) > self.state.capacity:
+            raise CapacityExceeded(
+                f"run of {len(elems)} won't fit "
+                f"({int(occupied.sum())}/{self.state.capacity} rows used)"
+            )
+        if index is None:
+            index = len(live_idx)
+        left = self._row(keys, live_idx[index - 1]) if index > 0 else None
+        right = (
+            self._row(keys, live_idx[index]) if index < len(live_idx) else None
+        )
+        rows = []
+        for i in range(len(elems)):
+            row = alloc_key(
+                left, right, self.rid, self._seq + i, self.state.depth
+            )
+            rows.append(row)
+            left = row  # chain: the next element types after this one
+        self._seq += len(elems)
+        # pad the run length to a power of two with SENTINEL rows so jit
+        # compiles O(log max_run) programs, not one per distinct length
+        n = len(rows)
+        p = 1
+        while p < n:
+            p *= 2
+        pad_row = (int(SENTINEL),) * (4 * self.state.depth)
+        rows += [pad_row] * (p - n)
+        self.state = insert_batch(self.state, rows, list(elems) + [0] * (p - n))
 
     def delete_at(self, index: int) -> None:
         keys, _, live_idx = self._snapshot()

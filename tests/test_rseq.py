@@ -404,6 +404,39 @@ def test_widen_unblocks_depth_cap_exhaustion():
     assert w1.to_list() == [1, 10, 55, 11, 4]
 
 
+def test_insert_run_single_union_matches_per_char():
+    """insert_run == the same run typed char by char (order, identities
+    aside), costs one union, and respects capacity + contiguity."""
+    w = rseq.SeqWriter(rseq.empty(64), rid=1)
+    w.append(1)
+    w.append(9)
+    w.insert_run(1, [100, 101, 102, 103])
+    assert w.to_list() == [1, 100, 101, 102, 103, 9]
+    assert w._seq == 6
+    w.insert_run(None, [7, 8])  # append mode
+    assert w.to_list() == [1, 100, 101, 102, 103, 9, 7, 8]
+    with pytest.raises(rseq.CapacityExceeded):
+        w.insert_run(0, list(range(100)))
+    w.insert_run(3, [])  # empty run is a no-op
+    assert w._seq == 8
+
+
+def test_concurrent_insert_runs_do_not_interleave():
+    """The batched API preserves the forward-typing non-interleaving
+    guarantee: two writers insert_run into the same gap concurrently and
+    the runs stay contiguous after the join."""
+    base = rseq.SeqWriter(rseq.empty(128), rid=0)
+    base.append(1)
+    base.append(9)
+    x = rseq.SeqWriter(base.state, rid=1)
+    y = rseq.SeqWriter(base.state, rid=2)
+    x.insert_run(1, [100 + i for i in range(10)])
+    y.insert_run(1, [200 + i for i in range(7)])
+    merged = rseq.to_list(rseq.join(x.state, y.state))
+    assert merged == [1] + [100 + i for i in range(10)] + \
+        [200 + i for i in range(7)] + [9]
+
+
 def test_seqwriter_restart_does_not_remint_identities():
     """A restarted writer (default seq_start) must resume ABOVE its own
     largest in-table seq — re-minting a used (rid, seq) would collide two

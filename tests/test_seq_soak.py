@@ -15,9 +15,11 @@ def test_seq_soak_short(seed):
 def test_seq_soak_exercises_gc_and_restarts():
     """A delete-heavy schedule with frequent barriers and restarts: rows
     must be reclaimed and restarted cursors must keep editing safely."""
+    # every probability named so the distribution sums to 1.0 exactly —
+    # an unnamed default would silently dilute the barrier weight
     r = SeqSoakRunner(
-        n=3, seed=5, capacity=256, p_insert=0.3, p_delete=0.22,
-        p_join=0.2, p_kill=0.0, p_revive=0.0, p_restart=0.1, p_barrier=0.15,
+        n=3, seed=5, capacity=256, p_insert=0.27, p_run=0.03, p_delete=0.22,
+        p_join=0.2, p_kill=0.0, p_revive=0.0, p_restart=0.1, p_barrier=0.18,
     ).run(300)
     assert r.barriers >= 3
     assert r.restarts >= 3
