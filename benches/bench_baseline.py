@@ -232,12 +232,16 @@ def _orset_union_rate(seed, c, ln, tiny, bank_n=None, chained_fn_cache={}):
     from crdt_tpu.utils.constants import SENTINEL
 
     # HBM budget (v5e: 16 GB): inputs 2·C·ln·4 B (a) + bank_n·2·C·ln·4 B,
-    # outputs 2·C·ln·4 B transient (out_size=C in-kernel truncation).  At
-    # 512K lanes a C=1024 array is 2 GB, so shrink the bank to ONE peer —
-    # the loop body stays collapse-proof because pallas_call is an opaque
-    # custom call XLA cannot algebraically simplify (unlike jnp.maximum).
+    # outputs 2·C·ln·4 B transient (out_size=C in-kernel truncation), PLUS
+    # the fori_loop carry (2 planes, double-buffered — not donatable: the
+    # timed calls reuse the operands).  At 256K lanes a C=1024 plane is
+    # 1 GB and a two-peer bank would push the working set past ~12 GB (it
+    # OOM'd with residue from earlier sweep points), so shrink the bank to
+    # ONE peer there — the loop body stays collapse-proof because
+    # pallas_call is an opaque custom call XLA cannot algebraically
+    # simplify (unlike jnp.maximum).
     if bank_n is None:
-        bank_n = 1 if c * ln * 4 >= (1 << 31) else 2
+        bank_n = 1 if c * ln * 4 >= (1 << 30) else 2
     interpret = jax.default_backend() != "tpu"
 
     def cols(key, fill):
@@ -281,8 +285,13 @@ def _orset_union_rate(seed, c, ln, tiny, bank_n=None, chained_fn_cache={}):
     ks_, kl = (2, 6) if tiny else (8, 32)
     per = _timed(lambda k: int(chained(ka, va, bank_k, bank_v, k)), ks_, kl,
                  min_diff=0 if tiny else MIN_DIFF_S)
-    # free this shape's operands before the caller builds the next stripe
+    # free this shape's operands before the caller builds the next stripe/
+    # sweep point; gc.collect() breaks any lingering cycles so the device
+    # buffers actually release (the 256K point needs the headroom)
     del ka, va, bank_k, bank_v, bank
+    import gc
+
+    gc.collect()
     return per
 
 
@@ -303,12 +312,16 @@ def bench_orset_union(results, tiny, lanes=None, capacity=None):
 
 
 def bench_orset_sweep(results, tiny):
-    """Measured lane sweep (128K -> 256K -> 512K at C=1024): the evidence
-    for lane-linearity that round 1 merely asserted.  At 512K lanes the
-    operand set only fits because out_size=C truncation happens in-kernel
-    and the peer bank shrinks to one entry (see _orset_union_rate)."""
+    """Measured lane sweep (64K -> 128K -> 256K at C=1024): the evidence
+    for lane-linearity that round 1 merely asserted.  The sweep tops out
+    at 256K lanes: at C=1024 each (C, L) plane is 1 GB there, and the
+    chained-loop working set (operands + peer bank + loop carry, which
+    cannot be donated because the timed calls reuse the operands) already
+    budgets ~8 GB of the 16 GB HBM — a 512K point OOMs.  The true 1M-lane
+    BASELINE shape is measured by the striped driver (bench_orset_1m),
+    which is also how that workload must actually execute on one chip."""
     c = 64 if tiny else 1024
-    lanes = (128, 256, 512) if tiny else (1 << 17, 1 << 18, 1 << 19)
+    lanes = (128, 256, 512) if tiny else (1 << 16, 1 << 17, 1 << 18)
     for ln in lanes:
         per = _orset_union_rate(4, c, ln, tiny)
         if per is None:
@@ -426,8 +439,52 @@ def write_md(results, path):
         v = r["value"]
         pretty = f"{v:,.1f}" if v < 1e6 else f"{v:.3e}"
         lines.append(f"| {r['metric']} | {pretty} | {r['unit']} | {r['note']} |")
-    lines.append("")
+    lines += [
+        "",
+        "Fused-kernel A/B tables (columnar Pallas vs generic XLA: the "
+        "lex2 OpLog engine and the lexN RSeq engine) live in `PERF.md`; "
+        "drivers: `benches/bench_oplog_columnar.py`, "
+        "`benches/bench_rseq_columnar.py`.",
+        "",
+    ]
     path.write_text("\n".join(lines))
+
+
+def _run_isolated(names, args):
+    """Run each bench in its OWN subprocess and collect its JSON lines.
+
+    The big-shape benches are sized to a large fraction of the chip's HBM
+    (the 256K-lane sweep point and each 128K stripe of the 1M driver
+    budget several GB of operands + loop carry); running them after the
+    smaller configs in one process leaves enough residue (executable
+    scratch, cached donation buffers) to trip RESOURCE_EXHAUSTED.  Process
+    isolation gives every config a clean HBM; the persistent compile cache
+    (_enable_compile_cache) keeps the repeated Mosaic compiles to one
+    each."""
+    import subprocess
+
+    results = []
+    for name in names:
+        cmd = [sys.executable, str(pathlib.Path(__file__).resolve()),
+               "--only", name]
+        if args.tiny:
+            cmd.append("--tiny")
+        if args.cpu:
+            cmd.append("--cpu")
+        if args.lanes is not None:
+            cmd += ["--lanes", str(args.lanes)]
+        if args.capacity is not None:
+            cmd += ["--capacity", str(args.capacity)]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(f"bench {name} failed (rc={proc.returncode})")
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                print(line, flush=True)
+                results.append(json.loads(line))
+    return results
 
 
 def main():
@@ -440,19 +497,27 @@ def main():
     ap.add_argument("--capacity", type=int, default=None)
     ap.add_argument("--write-md", action="store_true",
                     help="refresh BENCH_TABLE.md at the repo root")
+    ap.add_argument("--isolate", action="store_true",
+                    help="one subprocess per bench (clean HBM each; how the "
+                         "full suite must run on a 16 GB chip)")
     args = ap.parse_args()
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
 
-    results = []
-    for name, fn in ALL.items():
-        if args.only and name != args.only:
-            continue
-        if name == "orset_union":
-            fn(results, args.tiny, lanes=args.lanes, capacity=args.capacity)
-        else:
-            fn(results, args.tiny)
+    if args.isolate:
+        names = [args.only] if args.only else list(ALL)
+        results = _run_isolated(names, args)
+    else:
+        results = []
+        for name, fn in ALL.items():
+            if args.only and name != args.only:
+                continue
+            if name == "orset_union":
+                fn(results, args.tiny, lanes=args.lanes,
+                   capacity=args.capacity)
+            else:
+                fn(results, args.tiny)
     if args.write_md:
         write_md(results, REPO / "BENCH_TABLE.md")
 

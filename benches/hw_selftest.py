@@ -8,10 +8,16 @@ Checks, each against the generic XLA sorted_union on the same data:
   1. OR-combine fused union (sorted_union_columnar) at C=64 and C=1024;
   2. lex2 keep-first fused union (the OpLog path) incl. n_unique;
   3. columnar OpLog merge/converge vs the vmapped row-major path;
-  4. sharded_converge on a 1-device mesh (compiled Mosaic under shard_map).
+  4. sharded_converge on a 1-device mesh (compiled Mosaic under shard_map);
+  5. lexN (18-key-word) fused union: columnar RSeq merge vs the vmapped
+     generic 24-column join, incl. the tombstone OR-on-punch rule.
 
 Run after ANY kernel change:  python benches/hw_selftest.py
 Exit code 0 = all green.  ~1 min of compiles on a tunnel-attached chip.
+
+`bench.py` also runs the quick subset (`run(full=False)`) before producing
+its headline JSON whenever the backend is a real accelerator, so a Mosaic
+lowering regression cannot silently ship a BENCH_r* number.
 """
 import pathlib
 import sys
@@ -26,6 +32,9 @@ from crdt_tpu.models import oplog, oplog_columnar as oc
 from crdt_tpu.ops import pallas_union, sorted_union as su
 from crdt_tpu.parallel import mesh as mesh_lib
 from crdt_tpu.utils.constants import SENTINEL_PY
+
+
+_log = print  # rebound by run() so library callers can keep stdout clean
 
 
 def _cols(rng, c, lanes, fill_max):
@@ -53,7 +62,7 @@ def check_or_kernel(c):
         np.testing.assert_array_equal(np.asarray(keys[0]), np.asarray(ko[:, j]))
         np.testing.assert_array_equal(np.asarray(vals), np.asarray(vo[:, j]))
         assert int(n) == int(nu[j])
-    print(f"  OR-combine union C={c}: OK")
+    _log(f"  OR-combine union C={c}: OK")
 
 
 def check_lex2_kernel():
@@ -92,7 +101,7 @@ def check_lex2_kernel():
         np.testing.assert_array_equal(np.asarray(vals["a"]), np.asarray(vo1[:, j]))
         np.testing.assert_array_equal(np.asarray(vals["b"]), np.asarray(vo2[:, j]))
         assert int(n) == int(nu[j])
-    print("  lex2 keep-first union: OK")
+    _log("  lex2 keep-first union: OK")
 
 
 def _swarm(rng, c, r):
@@ -115,7 +124,7 @@ def check_columnar_oplog():
     np.testing.assert_array_equal(np.asarray(nu), np.asarray(wnu))
     conv = oc.converge(a)
     assert (np.asarray(conv.hi) == np.asarray(conv.hi[:, :1])).all()
-    print("  columnar OpLog merge/converge: OK")
+    _log("  columnar OpLog merge/converge: OK")
 
 
 def check_sharded():
@@ -127,17 +136,66 @@ def check_sharded():
     want = oc.converge(col)
     np.testing.assert_array_equal(np.asarray(out.hi), np.asarray(want.hi))
     np.testing.assert_array_equal(np.asarray(out.pay), np.asarray(want.pay))
-    print("  sharded_converge (shard_map + Mosaic): OK")
+    _log("  sharded_converge (shard_map + Mosaic): OK")
+
+
+def check_lexn_rseq():
+    """The lexN kernel (RSeq's 3·D packed key words + elem/removed planes)
+    compiled on the chip vs the generic 4·D-column join."""
+    from benches.bench_rseq_columnar import make_swarm_planes
+    from crdt_tpu.models import rseq, rseq_columnar as rc
+
+    col = make_swarm_planes(11, 128, 128)
+    rows = rc.unstack(col)
+    got, nu = rc.merge_checked(
+        jax.tree.map(lambda x: x[..., :64], col),
+        jax.tree.map(lambda x: x[..., 64:], col),
+    )
+    a = jax.tree.map(lambda x: x[:64], rows)
+    b = jax.tree.map(lambda x: x[64:], rows)
+    want, wnu = jax.vmap(rseq.join_checked)(a, b)
+    got_rows = rc.unstack(got)
+    np.testing.assert_array_equal(
+        np.asarray(got_rows.keys), np.asarray(want.keys)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_rows.elem), np.asarray(want.elem)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_rows.removed), np.asarray(want.removed)
+    )
+    np.testing.assert_array_equal(np.asarray(nu), np.asarray(wnu))
+    _log("  lexN RSeq union (18 key words): OK")
+
+
+def run(full=True, log=print):
+    """Run the self-test; raises on any kernel/oracle disagreement.
+
+    full=False is the ~30 s quick subset bench.py gates on: one OR-combine
+    shape, the lex2 keep-first kernel, and the columnar-vs-row-major OpLog
+    cross-check — enough that a Mosaic lowering break in any fused path
+    fails before a headline number is produced.  full=True adds the C=1024
+    OR shape, the shard_map-compiled sharded_converge, and the lexN RSeq
+    kernel.
+    """
+    global _log
+    _log = log
+    try:
+        log(f"devices: {jax.devices()}")
+        for c in (64, 1024) if full else (64,):
+            check_or_kernel(c)
+        check_lex2_kernel()
+        check_columnar_oplog()
+        if full:
+            check_sharded()
+            check_lexn_rseq()
+        log("hw_selftest: ALL OK")
+    finally:
+        _log = print
 
 
 def main():
-    print(f"devices: {jax.devices()}")
-    for c in (64, 1024):
-        check_or_kernel(c)
-    check_lex2_kernel()
-    check_columnar_oplog()
-    check_sharded()
-    print("hw_selftest: ALL OK")
+    run(full=True)
     return 0
 
 

@@ -14,13 +14,18 @@ multi-process/multi-host deployment:
     python -m crdt_tpu --daemon --rid 0 --port 8080 --peers http://h2:8080
     python -m crdt_tpu --daemon --rid 1 --port 8080 --peers http://h1:8080
 
-Go interop is ONE-DIRECTIONAL: these replicas can pull from and merge an
-original Go server's payloads (plain unix-ms keys arrive as rid=-1 foreign
-ops), but a Go server must never pull from a crdt_tpu replica — its gossip
-loop Atoi's each key and returns on the first "ts:rid:seq" key it meets
-(main.go:251-254, quirk §0.1.8), permanently killing that Go replica's
-anti-entropy.  In a fleet containing Go peers, also leave --compact-every
-at 0 (compaction payload sections are not Go-parseable; crdt_tpu.api.node).
+Go interop defaults to ONE-DIRECTIONAL: these replicas can pull from and
+merge an original Go server's payloads (plain unix-ms keys arrive as
+rid=-1 foreign ops), but a Go server must never pull from a crdt_tpu
+replica — its gossip loop Atoi's each key and returns on the first
+"ts:rid:seq" key it meets (main.go:251-254, quirk §0.1.8), permanently
+killing that Go replica's anti-entropy.  ``--go-compat-gossip`` makes it
+BIDIRECTIONAL: full-dump payloads switch to bare integer-ms keys a Go
+peer parses, at the reference's own price (same-ms ops collapse
+last-writer-per-ms, quirk §0.1.2; echoed ops dedup by ts identity).  In
+any fleet containing Go peers, leave --compact-every at 0 (compaction
+payload sections are not Go-parseable; crdt_tpu.api.node) — with
+--go-compat-gossip that rule is enforced.
 """
 from __future__ import annotations
 
@@ -110,10 +115,22 @@ def run_daemon(args) -> int:
               "(exactly one daemon in the fleet schedules barriers)",
               file=sys.stderr)
         return 2
+    if args.go_compat_gossip and (args.compact_every or args.full_gossip):
+        print("--go-compat-gossip forbids --compact-every and --full-gossip "
+              "(summary sections / lossy full dumps are for Go peers only)",
+              file=sys.stderr)
+        return 2
+    if args.set_collect_every and not args.coordinator:
+        print("--set-collect-every in --daemon mode requires --coordinator "
+              "(exactly one daemon schedules set GC barriers)",
+              file=sys.stderr)
+        return 2
     cfg = ClusterConfig(
         gossip_period_ms=args.gossip_ms,
         compact_every=args.compact_every,
         delta_gossip=not args.full_gossip,
+        go_compat_gossip=args.go_compat_gossip,
+        set_collect_every=args.set_collect_every,
     )
     peers = [u for u in (args.peers or "").split(",") if u]
     rid = args.rid
@@ -187,6 +204,15 @@ def main(argv=None) -> int:
     ap.add_argument("--full-gossip", action="store_true",
                     help="ship the full log every round (reference behavior) "
                          "instead of deltas")
+    ap.add_argument("--set-collect-every", type=int, default=0,
+                    help="daemon: run a set-lattice GC barrier every N "
+                         "gossip rounds (coordinator only; 0 = only "
+                         "explicit POST /admin/set_barrier)")
+    ap.add_argument("--go-compat-gossip", action="store_true",
+                    help="daemon: emit full-dump gossip with bare integer-ms "
+                         "keys so an ORIGINAL Go peer can pull from this "
+                         "node (lossy: last-writer-per-ms, quirk §0.1.2); "
+                         "makes interop bidirectional")
     ap.add_argument("--dump-state", action="store_true")
     ap.add_argument("--daemon", action="store_true",
                     help="run ONE network replica instead of the demo swarm")

@@ -25,6 +25,13 @@ from crdt_tpu.utils.metrics import Metrics
 class LocalCluster:
     def __init__(self, config: Optional[ClusterConfig] = None):
         self.config = config or ClusterConfig()
+        if self.config.go_compat_gossip and (
+            self.config.compact_every or not self.config.delta_gossip
+        ):
+            raise ValueError(
+                "go_compat_gossip requires delta_gossip=True and "
+                "compact_every=0 (crdt_tpu.api.node docstring)"
+            )
         self.metrics = Metrics()
         clock = HostClock()
         self.nodes: List[ReplicaNode] = [
@@ -33,6 +40,7 @@ class LocalCluster:
                 capacity=self.config.log_capacity,
                 clock=clock,
                 metrics=self.metrics,
+                go_compat_gossip=self.config.go_compat_gossip,
             )
             for i in range(self.config.n_replicas)
         ]

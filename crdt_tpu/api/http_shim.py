@@ -19,6 +19,16 @@ fleet deterministically, crdt_tpu.harness.crashsoak):
   POST /admin/pull              {"peer": url?} -> one gossip pull now
   POST /admin/barrier           one compaction barrier now (coordinator)
   POST /admin/checkpoint        crash-safe snapshot now
+  POST /admin/set_pull          {"peer": url?} -> one set pull now
+  POST /admin/set_barrier       one set GC barrier now (coordinator)
+
+Set-lattice surface (crdt_tpu.api.setnode; present only with ``admin``):
+  GET  /set                     {"members": [...]}
+  GET  /set/gossip[?vv=...]     floor-carrying (delta) set payload
+  GET  /set/vv                  {"vv": {rid: seq}, "floor": {rid: seq}}
+  POST /set/add                 {"elem": str} -> mint one add op
+  POST /set/remove              {"elem": str} -> observed-remove
+  POST /set/collect             {"floor": {rid: seq}} -> GC fold
 
 The /condition route takes the flag as a path segment (also accepted:
 ?alive_status=) — the reference registered the route without the parameter
@@ -56,9 +66,59 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
             self.end_headers()
             self.wfile.write(data)
 
+        @property
+        def set_node(self):
+            return getattr(admin, "set_node", None)
+
+        def _parse_vv_query(self, url):
+            """?vv=<json {rid: seq}> -> dict, None (absent), or the string
+            "bad" (unparseable — caller 400s)."""
+            q = parse_qs(url.query)
+            if "vv" not in q:
+                return None
+            try:
+                return {
+                    int(r): int(s)
+                    for r, s in json.loads(q["vv"][0]).items()
+                }
+            except Exception:
+                return "bad"
+
         def do_GET(self):
             url = urlparse(self.path)
             parts = [p for p in url.path.split("/") if p]
+            if parts and parts[0] == "set" and self.set_node is not None:
+                sn = self.set_node
+                if url.path == "/set":
+                    members = sn.members()
+                    if members is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps({"members": members}),
+                                   "application/json")
+                elif url.path == "/set/gossip":
+                    since = self._parse_vv_query(url)
+                    if since == "bad":
+                        self._send(400, "invalid vv")
+                        return
+                    payload = sn.gossip_payload(since=since)
+                    if payload is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps(payload),
+                                   "application/json")
+                elif url.path == "/set/vv":
+                    if not sn.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    vv, floor = sn.vv_snapshot()
+                    self._send(200, json.dumps({
+                        "vv": {str(r): s for r, s in vv.items()},
+                        "floor": {str(r): s for r, s in floor.items()},
+                    }), "application/json")
+                else:
+                    self._send(404, "not found")
+                return
             if url.path == "/ping":
                 if self.node.ping():
                     self._send(200, "Pong")
@@ -148,12 +208,72 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         else:
                             self._send(200, json.dumps({"snapshot": snap}),
                                        "application/json")
+                    elif path == "/admin/set_pull":
+                        ok = admin.admin_set_pull(body.get("peer"))
+                        self._send(200, json.dumps({"pulled": bool(ok)}),
+                                   "application/json")
+                    elif path == "/admin/set_barrier":
+                        floor = admin.admin_set_barrier()
+                        self._send(
+                            200,
+                            json.dumps({
+                                "floor": {str(r): s
+                                          for r, s in floor.items()}
+                            }),
+                            "application/json",
+                        )
                     else:
                         self._send(404, "not found")
                 except Exception as e:  # surfaced to the driving test: a
                     # failing pull/barrier is an invariant violation (I4),
                     # never a silent skip (the reference's quirk 0.1.8)
                     self._send(500, f"{type(e).__name__}: {e}")
+                return
+            if path.startswith("/set/") and self.set_node is not None:
+                sn = self.set_node
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    assert isinstance(body, dict)
+                except Exception:
+                    self._send(400, "invalid body")
+                    return
+                if path == "/set/add":
+                    ident = sn.add(str(body.get("elem", "")))
+                    if ident is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps(
+                            {"rid": ident[0], "seq": ident[1]}
+                        ), "application/json")
+                elif path == "/set/remove":
+                    if not sn.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    ident = sn.remove(str(body.get("elem", "")))
+                    op = sn.op_record(ident) if ident else None
+                    self._send(200, json.dumps({
+                        "removed": ident is not None,
+                        "rid": ident[0] if ident else None,
+                        "seq": ident[1] if ident else None,
+                        "tags": (op or {}).get("tags", []),
+                    }), "application/json")
+                elif path == "/set/collect":
+                    if not sn.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    try:
+                        floor = {
+                            int(r): int(s)
+                            for r, s in (body.get("floor") or {}).items()
+                        }
+                    except Exception:
+                        self._send(400, "invalid floor")
+                        return
+                    sn.collect(floor)
+                    self._send(200, "OK")
+                else:
+                    self._send(404, "not found")
                 return
             if path == "/compact":
                 n = int(self.headers.get("Content-Length", 0))

@@ -131,6 +131,35 @@ class RemotePeer:
             {"frontier": {str(r): s for r, s in frontier.items()}},
         )
 
+    # ---- set-lattice surface (crdt_tpu.api.setnode) ----
+
+    def set_gossip_payload(
+        self, since: Optional[Dict[int, int]] = None
+    ) -> Optional[Dict[str, Any]]:
+        """GET /set/gossip (floor-carrying delta; full fallback)."""
+        path = "/set/gossip"
+        if since is not None:
+            vv = json.dumps({str(r): s for r, s in since.items()})
+            path += "?vv=" + urllib.parse.quote(vv)
+        return self._parse(self._get(path))
+
+    def set_vv(self):
+        """GET /set/vv → (vv, floor) or None when down/unreachable."""
+        d = self._parse(self._get("/set/vv"))
+        if d is None:
+            return None
+        return (
+            {int(r): int(s) for r, s in (d.get("vv") or {}).items()},
+            {int(r): int(s) for r, s in (d.get("floor") or {}).items()},
+        )
+
+    def set_collect(self, floor: Dict[int, int]) -> bool:
+        """POST /set/collect: advance the GC floor (barrier fold)."""
+        return self._post(
+            "/set/collect",
+            {"floor": {str(r): s for r, s in floor.items()}},
+        )
+
 
 def network_compact(node: ReplicaNode, peers: List[RemotePeer]) -> Dict[int, int]:
     """One cross-daemon compaction barrier (the network analogue of
@@ -188,8 +217,10 @@ class NetworkAgent:
         metrics: Optional[Metrics] = None,
         seed: Optional[int] = None,
         coordinator: bool = False,
+        set_node=None,
     ):
         self.node = node
+        self.set_node = set_node  # optional SetNode sibling: pulled together
         self.peers = [RemotePeer(u) for u in peer_urls]
         self.config = config or ClusterConfig()
         self.metrics = metrics or node.metrics
@@ -206,13 +237,29 @@ class NetworkAgent:
             self.metrics.inc("net_gossip_skipped")
             return False
         peer = self._rng.choice(self.peers)
-        return pull_round(
+        merged = pull_round(
             self.node,
             peer.gossip_payload,
             self.metrics,
             delta=self.config.delta_gossip,
             prefix="net_gossip",
         )
+        return self.set_pull(peer) or merged
+
+    def set_pull(self, peer: RemotePeer) -> bool:
+        """One set-lattice pull from ``peer`` (no-op without a set node).
+        Always delta-requested: the sender itself decides when a full
+        payload is needed (the floor-validity rule, setnode.gossip_payload)."""
+        sn = self.set_node
+        if sn is None or not sn.alive:
+            return False
+        payload = peer.set_gossip_payload(since=sn.version_vector())
+        if payload is None:
+            self.metrics.inc("set_gossip_skipped")
+            return False
+        fresh = sn.receive(payload)
+        self.metrics.inc("set_gossip_rounds" if fresh else "set_gossip_noop")
+        return fresh > 0
 
     def start(self) -> None:
         self._stop.clear()
@@ -242,6 +289,31 @@ class NetworkAgent:
         )
         return frontier
 
+    def set_collect_once(self) -> dict:
+        """One cross-daemon set GC barrier (coordinator only): agree on the
+        stable floor over every member's set vv (chain-ruled against every
+        existing floor) and tell everyone to collect it.  Skipped (returns
+        {}) when any member is unreachable — stability cannot be proven
+        without it, same rule as network_compact.  A member that misses
+        the POST catches up by adopting the floor from any collected
+        peer's payload (setnode._adopt_floor_locked)."""
+        from crdt_tpu.api import setnode as setnode_mod
+
+        sn = self.set_node
+        if sn is None or not sn.alive:
+            self.metrics.inc("set_collect_skipped")
+            return {}
+        with ThreadPoolExecutor(max_workers=max(len(self.peers), 1)) as pool:
+            got = list(pool.map(lambda p: p.set_vv(), self.peers))
+            floor = setnode_mod.set_barrier(sn, got)
+            if not floor:
+                self.metrics.inc("set_collect_skipped")
+                return {}
+            sn.collect(floor)
+            list(pool.map(lambda p: p.set_collect(floor), self.peers))
+        self.metrics.inc("set_collections_scheduled")
+        return floor
+
     def _loop(self) -> None:
         period = self.config.gossip_period_ms / 1000.0
         rounds = 0
@@ -252,6 +324,12 @@ class NetworkAgent:
                 every = self.config.compact_every  # re-read: live reconfig
                 if self.coordinator and every and rounds % every == 0:
                     self.compact_once()
+                # set GC runs on its OWN cadence: KV compaction may be
+                # forbidden (go-compat fleets) while set tables still need
+                # their tombstones reclaimed
+                sce = self.config.set_collect_every
+                if self.coordinator and sce and rounds % sce == 0:
+                    self.set_collect_once()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.metrics.inc("net_gossip_loop_errors")
                 self.errors.append(e)
@@ -285,11 +363,28 @@ class NodeHost:
         checkpoint_every_s: float = 0,
     ):
         from crdt_tpu.api.http_shim import _make_handler
+        from crdt_tpu.api.setnode import SetNode
 
         self.config = config or ClusterConfig()
+        if self.config.go_compat_gossip and self.config.compact_every:
+            raise ValueError(
+                "go_compat_gossip forbids compaction (summary sections are "
+                "not Go-parseable); set compact_every=0"
+            )
+        if self.config.go_compat_gossip and not self.config.delta_gossip:
+            raise ValueError(
+                "go_compat_gossip requires delta_gossip=True for crdt_tpu "
+                "peers: a full pull would receive the lossy bare-ms dump "
+                "(rid-less foreign ops) meant for Go peers only"
+            )
         self.node = ReplicaNode(
-            rid=rid, capacity=capacity or self.config.log_capacity
+            rid=rid, capacity=capacity or self.config.log_capacity,
+            go_compat_gossip=self.config.go_compat_gossip,
         )
+        # the set-lattice sibling: same wire rid (namespaces are disjoint —
+        # set vv/floor never mix with the KV vv/frontier), gossiped and
+        # checkpointed alongside the KV node
+        self.set_node = SetNode(rid=rid)
         # crash recovery: restore the newest complete snapshot (if any)
         # BEFORE serving.  The caller is responsible for minting rid via
         # checkpoint.bump_incarnation when restores can land in a live
@@ -300,10 +395,13 @@ class NodeHost:
         if checkpoint_dir:
             from crdt_tpu.utils import checkpoint as ckpt
 
-            self.restored = ckpt.load_latest_node(checkpoint_dir, self.node)
+            self.restored = ckpt.load_latest_node(
+                checkpoint_dir, self.node, set_node=self.set_node
+            )
         self.nodes = [self.node]  # duck-types as a cluster for the handler
         self.agent = NetworkAgent(
-            self.node, peers, self.config, coordinator=coordinator
+            self.node, peers, self.config, coordinator=coordinator,
+            set_node=self.set_node,
         )
         self._server = ThreadingHTTPServer(
             (host, port), _make_handler(self, 0, admin=self)
@@ -373,7 +471,9 @@ class NodeHost:
             return None
         from crdt_tpu.utils import checkpoint as ckpt
 
-        return ckpt.save_node_atomic(self.checkpoint_dir, self.node)
+        return ckpt.save_node_atomic(
+            self.checkpoint_dir, self.node, set_node=self.set_node
+        )
 
     def admin_pull(self, peer_url: Optional[str] = None) -> bool:
         """One anti-entropy pull, now, from ``peer_url`` (or a random
@@ -392,3 +492,20 @@ class NodeHost:
         """One compaction barrier, now (this host must be the fleet's
         single coordinator)."""
         return self.agent.compact_once()
+
+    def admin_set_pull(self, peer_url: Optional[str] = None) -> bool:
+        """One set-lattice pull, now, from ``peer_url`` (or a random
+        configured peer)."""
+        if peer_url is None:
+            if not self.agent.peers:
+                return False
+            # the agent's seeded RNG, not the global module: pinned-seed
+            # soaks must replay their peer-selection schedules
+            peer = self.agent._rng.choice(self.agent.peers)
+        else:
+            peer = RemotePeer(peer_url)
+        return self.agent.set_pull(peer)
+
+    def admin_set_barrier(self) -> dict:
+        """One set GC barrier, now (coordinator only)."""
+        return self.agent.set_collect_once()

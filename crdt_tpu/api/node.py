@@ -134,10 +134,23 @@ class ReplicaNode:
         clock: Optional[HostClock] = None,
         metrics: Optional[Metrics] = None,
         use_native: Optional[bool] = None,
+        go_compat_gossip: bool = False,
     ):
         from crdt_tpu import native
 
         self.rid = rid
+        # Opt-in MIXED-FLEET mode (round-2 verdict, missing #1): emit
+        # full-dump gossip with the reference's BARE integer-ms keys so an
+        # original Go peer can pull from this node without its Atoi loop
+        # dying (/root/reference/main.go:251-254, quirk §0.1.8).
+        # Documented lossiness: ops sharing a millisecond collapse to the
+        # LAST writer's command per ms (highest (rid, seq) wins — the
+        # deterministic analogue of the reference's own treemap-Put
+        # overwrite, quirk §0.1.2).  crdt_tpu peers in such a fleet must
+        # keep delta_gossip=True (delta payloads stay in native format);
+        # compaction is forbidden (summary sections are not Go-parseable —
+        # compact() raises).
+        self.go_compat_gossip = bool(go_compat_gossip)
         self.clock = clock or HostClock()
         self.metrics = metrics or Metrics()
         # native C++ interner + batch packer when built (identical semantics,
@@ -170,6 +183,14 @@ class ReplicaNode:
         self._by_writer: Dict[int, List[Tuple[Tuple[int, int, int], Dict[str, str]]]] = {}
         self._foreign: List[Tuple[Tuple[int, int, int], Dict[str, str]]] = []
         self._vv: Dict[int, int] = {}
+        # go-compat echo dedup: ops round-tripping through a Go peer come
+        # back with their identity flattened to the bare ts (rid=-1).  In
+        # go-compat mode op identity therefore degrades to the reference's
+        # own ts-identity for FOREIGN rows: a rid<0 op whose ts any held op
+        # already occupies is a re-echo (or a same-ms collision, which the
+        # mode's last-writer-per-ms rule already declares lossy) and is
+        # dropped — the reference's local-wins rule, quirk §0.1.2.
+        self._ts_seen: set = set()
         # compaction state (crdt_tpu.models.compactlog): per-writer folded
         # watermark + the per-key fold of everything under it.  Summary
         # entries are wire-shaped: {"num", "num_count", "ts" (absolute ms),
@@ -291,6 +312,17 @@ class ReplicaNode:
     def _payload_locked(self, since: Optional[Dict[int, int]]) -> Dict[str, Any]:
         epoch = self.clock.epoch_ms
         if since is None:
+            if self.go_compat_gossip:
+                # reference-format full dump: bare integer-ms keys a Go
+                # peer's Atoi loop parses (main.go:251-254).  Iteration is
+                # (ts, rid, seq)-ascending, so same-ms ops collapse to the
+                # highest (rid, seq) — last-writer-per-ms, documented
+                # lossiness mirroring the reference's own treemap-Put
+                # collision rule (quirk §0.1.2)
+                return {
+                    str(k[0] + epoch): dict(v)
+                    for k, v in sorted(self._commands.items())
+                }
             # full dump of retained raw ops, ts-sorted like the
             # reference's treemap JSON (main.go:159); Go-compatible only
             # while this node has never compacted (see docstring)
@@ -331,7 +363,10 @@ class ReplicaNode:
         if not self.alive:
             return None
         with self._lock:
-            if self._wire is not None and not self._needs_sections_locked(since):
+            if self._wire is not None and not self._needs_sections_locked(since) \
+                    and not (self.go_compat_gossip and since is None):
+                # (the C++ emitter writes native ts:rid:seq keys; go-compat
+                # full dumps take the Python path)
                 return self._wire.payload_json(since)
             payload = self._payload_locked(since)
         return json.dumps(payload).encode()
@@ -392,6 +427,12 @@ class ReplicaNode:
         (compactlog.compact) and is decoded back to the wire-shaped host
         summary — one semantics, two representations.
         """
+        if self.go_compat_gossip:
+            raise ValueError(
+                "compaction is forbidden in go-compat gossip mode: a folded "
+                "node's payload needs the __summary__ sections, which a Go "
+                "peer cannot parse (its gossip loop would die, quirk §0.1.8)"
+            )
         with self._lock:
             vv = self._version_vector_locked()
             target = {
@@ -493,6 +534,9 @@ class ReplicaNode:
         self._by_writer = {}
         self._foreign = []
         self._vv = {}
+        self._ts_seen = (
+            {k[0] for k in self._commands} if self.go_compat_gossip else set()
+        )
         self._summary_cache = None
         if self._wire is not None:
             from crdt_tpu import native
@@ -614,8 +658,12 @@ class ReplicaNode:
                 continue  # duplicate op (gossip re-delivery): union no-op
             if rid >= 0 and seq <= f.get(rid, -1):
                 continue  # already folded into the summary
+            if self.go_compat_gossip and rid < 0 and ts in self._ts_seen:
+                continue  # go-compat echo: ts-identity local-wins (§0.1.2)
             stored = dict(cmd)
             self._commands[ident] = stored
+            if self.go_compat_gossip:
+                self._ts_seen.add(ts)
             if self._wire is not None:
                 self._wire.add(ts + self.clock.epoch_ms, rid, seq, stored)
             if rid >= 0:

@@ -38,6 +38,22 @@ Invariants checked at heal time:
                       kills, restores with stale frontiers, and revival
                       merges are all legal schedules (frontier chain rule).
 
+Round 3 adds the SET workload (crdt_tpu.api.setnode: OR-Set + tombstone
+GC + floor-carrying deltas) to the same kill/restore schedule — GC
+barriers race SIGKILLs and snapshot restores, the round-2 verdict's
+hardest untested interaction.  Set invariants at heal:
+
+  S1  durability    — converged membership == the observed-remove fold of
+                      exactly the vv-surviving set ops (no resurrection of
+                      collected tags, no lost removal — both falsify the
+                      fold); checkpointed/live-writer watermark rules as I1.
+  S2  floor safety  — every node's heal-time GC floor dominates the last
+                      successful barrier's floor (floors are monotone
+                      across incarnation restores; a stale-snapshot floor
+                      must be absorbed, never roll the fleet back).
+  S3  safety        — no set pull/collect/barrier ever 500s (the floor
+                      chain rule holds on every schedule).
+
 CLI (long sweeps):  python -m crdt_tpu.harness.crashsoak --steps 300
 CI runs a short seeded schedule (tests/test_crash_soak.py).
 """
@@ -173,6 +189,13 @@ class CrashReport:
     ops_lost_to_crashes: int = 0
     rounds_to_converge: int = -1
     final_keys: int = 0
+    set_adds: int = 0
+    set_removes: int = 0
+    set_pulls: int = 0
+    set_barriers: int = 0
+    set_barriers_empty: int = 0
+    set_ops_lost: int = 0
+    final_members: int = 0
 
     def __str__(self) -> str:
         return (
@@ -183,7 +206,10 @@ class CrashReport:
             f"{self.restores} restores (+{self.soft_kills}/"
             f"{self.soft_revives} soft), {self.ops_lost_to_crashes} ops "
             f"crash-lost, converged in {self.rounds_to_converge} rounds, "
-            f"{self.final_keys} keys"
+            f"{self.final_keys} keys; set: {self.set_adds}+{self.set_removes}"
+            f" ops, {self.set_pulls} pulls, {self.set_barriers} GC barriers "
+            f"(+{self.set_barriers_empty} empty), {self.set_ops_lost} "
+            f"crash-lost, {self.final_members} members"
         )
 
 
@@ -216,6 +242,14 @@ class CrashSoakRunner:
         self.ops: List[Tuple[int, int, Dict[str, str]]] = []  # (rid, seq, cmd)
         self.accepted_per_boot: Dict[int, int] = {}   # wire_rid -> count
         self.ckpt_watermark: Dict[int, int] = {}      # wire_rid -> count at ckpt
+        # set-lattice oracle: accepted set ops with minted identities —
+        # adds (rid, seq, elem) and removes (rid, seq, [targets])
+        self.set_adds: List[Tuple[int, int, str]] = []
+        self.set_removes: List[Tuple[int, int, List[Tuple[int, int]]]] = []
+        self.set_accepted_per_boot: Dict[int, int] = {}
+        self.set_ckpt_watermark: Dict[int, int] = {}
+        self.last_set_floor: Dict[int, int] = {}      # S2 monotonicity bar
+        self.set_elems = [f"s{i}" for i in range(n_keys)]
         self.report = CrashReport()
 
     # ---- schedule actions ----
@@ -240,6 +274,70 @@ class CrashSoakRunner:
 
     def _running(self) -> List[Daemon]:
         return [d for d in self.daemons if d.running]
+
+    # ---- set-lattice actions (S-invariants) ----
+
+    def _set_write(self) -> None:
+        r = self.report
+        d = self.rng.choice(self.daemons)
+        if not d.running:
+            return
+        rid = d.wire_rid
+        if self.rng.random() < 0.65:
+            elem = self.rng.choice(self.set_elems)
+            code, body = _http(d.url + "/set/add", "POST", {"elem": elem})
+            if code == 200:
+                got = json.loads(body)
+                seq = self.set_accepted_per_boot.get(rid, 0)
+                assert (got["rid"], got["seq"]) == (rid, seq), (
+                    f"S1: daemon minted {got['rid']}:{got['seq']}, oracle "
+                    f"expected {rid}:{seq}"
+                )
+                self.set_accepted_per_boot[rid] = seq + 1
+                self.set_adds.append((rid, seq, elem))
+                r.set_adds += 1
+        else:
+            elem = self.rng.choice(self.set_elems)
+            code, body = _http(d.url + "/set/remove", "POST", {"elem": elem})
+            if code == 200:
+                got = json.loads(body)
+                if got["removed"]:
+                    seq = self.set_accepted_per_boot.get(rid, 0)
+                    self.set_accepted_per_boot[rid] = seq + 1
+                    self.set_removes.append((
+                        rid, seq,
+                        [tuple(map(int, t)) for t in got["tags"]],
+                    ))
+                    r.set_removes += 1
+
+    def _set_pull(self) -> None:
+        up = self._running()
+        if not up:
+            return
+        d = self.rng.choice(up)
+        peer = self.rng.choice(d.peer_urls)
+        code, body = _http(d.url + "/admin/set_pull", "POST", {"peer": peer})
+        assert code == 200, f"S3: set pull 500d: {body!r}"
+        self.report.set_pulls += json.loads(body)["pulled"]
+
+    def _set_barrier(self) -> None:
+        d = self.daemons[0]  # the fleet's single coordinator
+        if not d.running:
+            return
+        code, body = _http(d.url + "/admin/set_barrier", "POST", {})
+        assert code == 200, f"S3: set barrier 500d: {body!r}"
+        floor = {int(k): int(v) for k, v in json.loads(body)["floor"].items()}
+        if floor:
+            # S2 bookkeeping: successful barriers advance monotonically
+            for k, v in self.last_set_floor.items():
+                assert floor.get(k, -1) >= v, (
+                    f"S2: barrier floor regressed at writer {k}: "
+                    f"{floor} < {self.last_set_floor}"
+                )
+            self.last_set_floor = floor
+            self.report.set_barriers += 1
+        else:
+            self.report.set_barriers_empty += 1
 
     def _pull(self) -> None:
         up = self._running()
@@ -270,9 +368,11 @@ class CrashSoakRunner:
         code, body = _http(d.url + "/admin/checkpoint", "POST", {})
         assert code == 200, f"I4: checkpoint failed: {body!r}"
         # durability bar: everything this boot accepted so far must
-        # survive any later crash of this incarnation
+        # survive any later crash of this incarnation (KV and set alike —
+        # one snapshot covers both sections)
         rid = d.wire_rid
         self.ckpt_watermark[rid] = self.accepted_per_boot.get(rid, 0)
+        self.set_ckpt_watermark[rid] = self.set_accepted_per_boot.get(rid, 0)
         self.report.checkpoints += 1
 
     def _soft_toggle(self) -> None:
@@ -304,12 +404,18 @@ class CrashSoakRunner:
 
     def step(self) -> None:
         x = self.rng.random()
-        if x < 0.40:
+        if x < 0.25:
             self._write()
-        elif x < 0.65:
+        elif x < 0.40:
+            self._set_write()
+        elif x < 0.55:
             self._pull()
-        elif x < 0.75:
+        elif x < 0.63:
+            self._set_pull()
+        elif x < 0.70:
             self._barrier()
+        elif x < 0.77:
+            self._set_barrier()
         elif x < 0.85:
             self._checkpoint()
         elif x < 0.88:
@@ -342,14 +448,24 @@ class CrashSoakRunner:
             # convergence = equal STATES and equal VERSION VECTORS: two
             # states can agree by luck while an undelivered delta-0 op is
             # still missing somewhere — vv equality closes that hole
-            vvs = []
+            vvs, set_vvs, set_members = [], [], []
             for d in self.daemons:
                 code, body = _http(d.url + "/vv")
                 vvs.append(json.loads(body)["vv"] if code == 200 else None)
+                code, body = _http(d.url + "/set/vv")
+                set_vvs.append(
+                    json.loads(body)["vv"] if code == 200 else None
+                )
+                code, body = _http(d.url + "/set")
+                set_members.append(
+                    json.loads(body)["members"] if code == 200 else None
+                )
             if (
                 all(s is not None for s in states)
                 and all(s == states[0] for s in states[1:])
                 and all(v == vvs[0] for v in vvs)
+                and all(v == set_vvs[0] for v in set_vvs)
+                and all(m == set_members[0] for m in set_members)
             ):
                 break
             assert rounds < max_rounds, f"liveness violated (I3): {states}"
@@ -358,6 +474,9 @@ class CrashSoakRunner:
                     code, body = _http(d.url + "/admin/pull", "POST",
                                        {"peer": peer})
                     assert code == 200, f"I4: heal pull 500d: {body!r}"
+                    code, body = _http(d.url + "/admin/set_pull", "POST",
+                                       {"peer": peer})
+                    assert code == 200, f"S3: heal set pull 500d: {body!r}"
             rounds += 1
         r.rounds_to_converge = rounds
 
@@ -399,6 +518,65 @@ class CrashSoakRunner:
             f"{ {k: (want.get(k), got.get(k)) for k in set(want) | set(got) if want.get(k) != got.get(k)} }"
         )
         r.final_keys = len(got)
+
+        # ---- set invariants (S1/S2) over the converged fleet ----
+        code, body = _http(self.daemons[0].url + "/set/vv")
+        assert code == 200
+        got_set = json.loads(body)
+        set_vv = {int(k): int(v) for k, v in got_set["vv"].items()}
+        set_floor = {int(k): int(v) for k, v in got_set["floor"].items()}
+
+        # S2: the heal-time floor dominates the last successful barrier —
+        # a restore from a pre-barrier snapshot must be absorbed by the
+        # chain rule, never roll the fleet's floor back
+        for k, v in self.last_set_floor.items():
+            assert set_floor.get(k, -1) >= v, (
+                f"S2: floor rolled back at writer {k}: {set_floor} < "
+                f"{self.last_set_floor}"
+            )
+
+        # S1a/S1b: watermark rules, same shape as I1a/I1b
+        for rid, bar in self.set_ckpt_watermark.items():
+            assert set_vv.get(rid, -1) >= bar - 1, (
+                f"S1a: checkpointed set ops lost: writer {rid} had {bar}, "
+                f"fleet holds {set_vv.get(rid, -1) + 1}"
+            )
+        for d in self.daemons:
+            rid = d.wire_rid
+            n = self.set_accepted_per_boot.get(rid, 0)
+            assert set_vv.get(rid, -1) == n - 1, (
+                f"S1b: live set writer {rid} accepted {n}, fleet holds "
+                f"{set_vv.get(rid, -1) + 1}"
+            )
+
+        # S1c: converged membership == observed-remove fold of exactly the
+        # vv-surviving ops (resurrection of a collected tag or a lost
+        # removal would both falsify this)
+        surviving_adds = [
+            (rid, seq, elem) for rid, seq, elem in self.set_adds
+            if seq <= set_vv.get(rid, -1)
+        ]
+        dead_tags = set()
+        set_survived = len(surviving_adds)
+        for rid, seq, targets in self.set_removes:
+            if seq <= set_vv.get(rid, -1):
+                set_survived += 1
+                dead_tags.update(targets)
+        want_members = sorted({
+            elem for rid, seq, elem in surviving_adds
+            if (rid, seq) not in dead_tags
+        })
+        r.set_ops_lost = (
+            len(self.set_adds) + len(self.set_removes) - set_survived
+        )
+        code, body = _http(self.daemons[0].url + "/set")
+        assert code == 200
+        got_members = json.loads(body)["members"]
+        assert got_members == want_members, (
+            f"S1c: membership diverged from the surviving-op fold: "
+            f"fleet={got_members} oracle={want_members}"
+        )
+        r.final_members = len(got_members)
         return r
 
     def close(self) -> None:

@@ -505,11 +505,22 @@ class SeqWriter:
     the join suppression rule would silently drop the fresh insert.  By
     default the counter resumes above the largest seq this writer has IN
     ``state`` (safe for plain-RSeq restarts: a writer's own rows survive
-    until removed).  Deployments running tombstone GC must pass
-    ``seq_start=tomb_gc.next_seq(g, rid)`` (floor-aware) or persist the
-    counter across restarts like crdt_tpu.utils.clock.SeqGen."""
+    until removed).  Deployments running tombstone GC must construct the
+    writer FROM the ``tomb_gc.Gc`` wrapper (accepted directly — the resume
+    is then floor-aware, max(table, floor) + 1 = ``tomb_gc.next_seq``), or
+    pass an explicit ``seq_start`` / persist the counter across restarts
+    like crdt_tpu.utils.clock.SeqGen.  When given a Gc wrapper, ``.state``
+    still tracks the plain RSeq — re-wrap with ``g.replace(inner=w.state)``
+    as the GC soaks do."""
 
-    def __init__(self, state: RSeq, rid: int, seq_start: int | None = None):
+    def __init__(self, state, rid: int, seq_start: int | None = None):
+        floor = None
+        if hasattr(state, "inner") and hasattr(state, "floor"):
+            # tomb_gc.Gc wrapper (duck-typed: rseq must not import tomb_gc)
+            floor = state.floor
+            state = state.inner
+        if not isinstance(state, RSeq):
+            raise TypeError(f"SeqWriter needs an RSeq or Gc[RSeq], got {type(state)}")
         self.state = state
         self.rid = rid
         if seq_start is None:
@@ -522,6 +533,10 @@ class SeqWriter:
             valid = np.asarray(state.keys[:, 0]) != int(SENTINEL)
             mine = valid & (rids == rid)
             seq_start = int(seqs[mine].max(initial=-1)) + 1
+            if floor is not None:
+                # rows at/under the floor may have been collected; re-minting
+                # their (rid, seq) would be join-suppressed as already-GC'd
+                seq_start = max(seq_start, int(np.asarray(floor)[rid]) + 1)
         self._seq = seq_start
 
     def _snapshot(self):

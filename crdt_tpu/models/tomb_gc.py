@@ -30,11 +30,15 @@ The fix is the same stable-frontier machinery the OpLog compaction uses
   harness caught exactly this).  Matched rows are never suppressed, so a
   straggler's tombstone flag still ORs in (a removal that never gossiped
   out is applied late, not lost).  Absence-implies-collected holds
-  because transfers are FULL-STATE unions: a writer's own table always
-  carries its whole live-add prefix, so a covered seq can disappear only
-  through collection (never through a transfer gap) — which is also why
-  delta transport and unchecked capacity overflow are excluded for GC
-  lattices.
+  because device-level transfers are FULL-STATE unions: a writer's own
+  table always carries its whole live-add prefix, so a covered seq can
+  disappear only through collection (never through a transfer gap).
+  Unchecked capacity overflow stays excluded (use the *_checked joins).
+  Delta transport DOES compose with GC at the host layer: the
+  floor-carrying delta protocol (crdt_tpu.api.setnode) identifies
+  removals as ops, requires a delta receiver's vv to dominate the
+  sender's floor, and falls back to a marked full payload (with this
+  module's absence-implies-collected suppression) otherwise.
 
 Chain rule and clamping mirror compactlog: floors only advance to
 swarm-agreed values, any two live floors are comparable, and ``collect``
@@ -117,6 +121,30 @@ def join_checked(a: Gc, b: Gc, adapter):
     Returns (Gc, n_unique): n_unique counts post-suppression unique rows;
     > capacity means truncation broke the state (treat as an error when GC
     is active — seq contiguity is a GC invariant)."""
+    # explicit if/raise, not assert (asserts vanish under python -O, and
+    # sorted_union's own n_keys assert would zip-truncate a mixed-depth
+    # join into silent corruption); shapes are static, so these checks run
+    # once per trace
+    ka, kb = adapter.key_cols(a.inner), adapter.key_cols(b.inner)
+    if len(ka) != len(kb) or any(x.shape != y.shape for x, y in zip(ka, kb)):
+        raise ValueError(
+            f"GC join requires identical key layouts: "
+            f"{[x.shape for x in ka]} vs {[y.shape for y in kb]} "
+            "(mixed-depth RSeq states must be widened to a common depth "
+            "before joining)"
+        )
+    if adapter.capacity_of(a.inner) != adapter.capacity_of(b.inner):
+        raise ValueError(
+            f"GC join requires equal capacities ({adapter.capacity_of(a.inner)}"
+            f" vs {adapter.capacity_of(b.inner)}) — the output is sliced to "
+            "one capacity, so unequal tables would make the join asymmetric; "
+            "grow() the smaller state first"
+        )
+    if a.floor.shape != b.floor.shape:
+        raise ValueError(
+            f"GC join requires equal writer counts: floor shapes "
+            f"{a.floor.shape} vs {b.floor.shape}"
+        )
     # src marker rides the value planes: 1 = only a, 2 = only b, 3 = both
     va = {"v": adapter.vals(a.inner), "src": jnp.ones_like(adapter.valid(a.inner), jnp.int32)}
     vb = {"v": adapter.vals(b.inner), "src": jnp.full_like(adapter.valid(b.inner), 2, jnp.int32)}
@@ -153,9 +181,19 @@ def join_checked(a: Gc, b: Gc, adapter):
     return Gc(inner=inner, floor=jnp.maximum(a.floor, b.floor)), n_unique
 
 
-@partial(jax.jit, static_argnames="adapter")
 def join(a: Gc, b: Gc, adapter) -> Gc:
-    out, _ = join_checked(a, b, adapter)
+    """Convenience join that REFUSES capacity overflow (GcOverflow) instead
+    of silently truncating — truncation drops by key order, not seq order,
+    which breaks the per-writer contiguity the floor-coverage proof rests
+    on (silent permanent data loss).  The host-side n_unique check forces a
+    device sync; throughput paths (vmapped barriers) use ``join_checked``
+    and batch the check like gc_round does."""
+    out, n_unique = join_checked(a, b, adapter)
+    cap = adapter.capacity_of(a.inner)
+    if int(n_unique) > cap:
+        raise GcOverflow(
+            f"GC join needs {int(n_unique)} rows but capacity is {cap}"
+        )
     return out
 
 

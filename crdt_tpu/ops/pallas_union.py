@@ -147,7 +147,7 @@ def _shift_down(x, s, fill):
 
 def _hole_compact(key_planes, val_planes, n):
     """Steps 3-4 of the fused union pipeline, shared by the OR-combine
-    (_union_kernel) and lex2 keep-first (_make_lex2_union_kernel) kernels:
+    (_union_kernel) and lexN keep-first (_make_lexn_union_kernel) kernels:
 
       3. displacement D[i] = holes strictly before row i, via a
          Hillis-Steele prefix sum (log2(n) shift-adds);
@@ -287,61 +287,62 @@ def sorted_union_columnar_fused(
     return ko, vo, nu[0]
 
 
-def _merge_stages_lex(planes, n):
-    """Two-word lexicographic wrapper over _merge_stages_planes:
-    ``planes[0]``/``planes[1]`` are the (hi, lo) key words and decide the
-    swap mask; every further plane (values) swaps under the same mask.
-    This is what lets the OpLog's 4-column (ts, rid, seq, key) identity
-    ride the kernel: ts is the hi word, (rid | seq | key) bit-pack into
-    the lo word (crdt_tpu.models.oplog_columnar)."""
-    return _merge_stages_planes(planes, n, n_keys=2)
 
-
-def _make_lex2_union_kernel(n_vals: int):
-    """Build the fused lex2-key union kernel for ``n_vals`` value planes.
+def _make_lexn_union_kernel(n_keys: int, n_vals: int):
+    """Build the fused lexN-key union kernel for ``n_keys`` key planes and
+    ``n_vals`` value planes.
 
     Same fused pipeline as _union_kernel (merge → dup punch → prefix-sum
-    displacement → log-step compaction, one VMEM round trip) with two
-    differences: the sort key is the lexicographic (hi, lo) word pair, and
-    the duplicate rule is KEEP-FIRST — callers guarantee identical keys
-    carry identical values (CRDT op identity: the same (ts, rid, seq, key)
-    is the same op), so the second copy is simply punched to a hole and no
-    value combine is needed.
+    displacement → log-step compaction, one VMEM round trip) with the sort
+    key generalized to the lexicographic ``n_keys``-word tuple.  The
+    duplicate rule is OR-COMBINE-THEN-KEEP-FIRST: the second copy's value
+    planes OR into the first before it is punched to a hole.  For callers
+    whose identical keys carry identical values (CRDT op identity — the
+    OpLog path) the OR is a no-op (x | x == x) and this is exactly
+    keep-first; monotone 0/1 flag planes (RSeq tombstones) get true join
+    semantics, so a removal held by only one side survives whichever copy
+    the network keeps.  n_keys=2 is the OpLog lex2 path; RSeq's packed
+    path keys ride n_keys=3·depth (crdt_tpu.models.rseq_columnar).
     """
 
     def kernel(*refs):
-        ins, outs = refs[: 4 + 2 * n_vals], refs[4 + 2 * n_vals:]
-        ka_hi, ka_lo = ins[0], ins[1]
-        va = ins[2 : 2 + n_vals]
-        kbr_hi, kbr_lo = ins[2 + n_vals], ins[3 + n_vals]
-        vb = ins[4 + n_vals :]
-        ko_hi, ko_lo = outs[0], outs[1]
-        vo = outs[2 : 2 + n_vals]
-        nu_ref = outs[2 + n_vals]
+        n_in = n_keys + n_vals
+        ins, outs = refs[: 2 * n_in], refs[2 * n_in :]
+        ka = ins[:n_keys]
+        va = ins[n_keys:n_in]
+        kbr = ins[n_in : n_in + n_keys]
+        vb = ins[n_in + n_keys :]
+        ko = outs[:n_keys]
+        vo = outs[n_keys:n_in]
+        nu_ref = outs[n_in]
 
-        c = ka_hi.shape[0]
+        c = ka[0].shape[0]
         n = 2 * c
-        out_rows = ko_hi.shape[0]
+        out_rows = ko[0].shape[0]
         planes = [
-            jnp.concatenate([ka_hi[:], kbr_hi[:]], axis=0),
-            jnp.concatenate([ka_lo[:], kbr_lo[:]], axis=0),
+            jnp.concatenate([a[:], b[:]], axis=0) for a, b in zip(ka, kbr)
         ] + [jnp.concatenate([a[:], b[:]], axis=0) for a, b in zip(va, vb)]
-        planes = _merge_stages_lex(planes, n)
-        khi, klo, vals = planes[0], planes[1], planes[2:]
+        planes = _merge_stages_planes(planes, n, n_keys=n_keys)
+        keys, vals = planes[:n_keys], planes[n_keys:]
 
-        # keep-first duplicate punch (one-row lookback: inputs have unique
-        # keys, so each key occurs at most twice in the merged columns)
-        prev_hi = _shift_down(khi, 1, SENTINEL)
-        prev_lo = _shift_down(klo, 1, SENTINEL)
-        dup = (khi == prev_hi) & (klo == prev_lo) & (khi != SENTINEL)
-        khi = jnp.where(dup, SENTINEL, khi)
-        klo = jnp.where(dup, SENTINEL, klo)
+        # duplicate punch (one-row lookback: inputs have unique keys, so
+        # each key occurs at most twice in the merged columns).  The
+        # punched copy's values OR into the kept copy first (see above).
+        dup = keys[0] != SENTINEL
+        for k in keys:
+            dup = dup & (k == _shift_down(k, 1, SENTINEL))
+        # masks shift as int32: Mosaic cannot concatenate i1 vregs
+        next_dup = _shift_up(dup.astype(jnp.int32), 1, 0) != 0
+        vals = [
+            jnp.where(next_dup, v | _shift_up(v, 1, 0), v) for v in vals
+        ]
+        keys = [jnp.where(dup, SENTINEL, k) for k in keys]
         vals = [jnp.where(dup, 0, v) for v in vals]
 
-        (khi, klo), vals, nu_row = _hole_compact([khi, klo], vals, n)
+        keys, vals, nu_row = _hole_compact(keys, vals, n)
         nu_ref[:] = nu_row
-        ko_hi[:] = khi[:out_rows]
-        ko_lo[:] = klo[:out_rows]
+        for ref, k in zip(ko, keys):
+            ref[:] = k[:out_rows]
         for ref, v in zip(vo, vals):
             ref[:] = v[:out_rows]
 
@@ -349,34 +350,41 @@ def _make_lex2_union_kernel(n_vals: int):
 
 
 @partial(jax.jit, static_argnames=("out_size", "interpret"))
-def sorted_union_columnar_fused_lex2(
-    keys_a,          # (hi, lo): pair of int32[C, L], per-lane sorted asc
+def sorted_union_columnar_fused_lexn(
+    keys_a,          # tuple of int32[C, L] key planes, per-lane sorted asc
     vals_a,          # tuple of int32[C, L] value planes
     keys_b,
     vals_b,
     out_size: int | None = None,
     interpret: bool = False,
 ):
-    """Fused batched sorted-set union with a two-word lexicographic key —
-    the OpLog fast path (crdt_tpu.models.oplog_columnar).  Contract mirrors
-    sorted_union_columnar_fused, except:
+    """Fused batched sorted-set union with an N-word lexicographic key.
+    Contract mirrors sorted_union_columnar_fused, except:
 
-    * keys are (hi, lo) pairs compared lexicographically (padding rows have
-      hi = lo = SENTINEL; real rows have hi < SENTINEL);
-    * duplicates resolve KEEP-FIRST: callers must guarantee identical keys
-      carry identical value rows (true for op logs: the key IS the op
-      identity) — this replaces the OR-combiner, which is wrong for
-      non-monotone payloads like numeric deltas;
+    * keys are N-word tuples compared lexicographically (padding rows have
+      every word = SENTINEL; real rows have word 0 < SENTINEL — callers
+      whose packing could saturate word 0 must reserve a bit, as
+      rseq_columnar's 30-bit head plane does);
+    * duplicates OR-combine into the kept (first) copy: planes whose two
+      copies are identical pass through unchanged (x | x == x — op-identity
+      payloads like numeric deltas are safe because the copies ARE equal),
+      and monotone 0/1 flag planes (tombstones) get true join semantics;
     * any number of int32 value planes travels through the network.
 
-    Returns ((hi, lo), vals_tuple, n_unique[L]); n_unique is the
+    Returns (keys_tuple, vals_tuple, n_unique[L]); n_unique is the
     pre-truncation unique count, so overflow (n_unique > out_size) stays
-    detectable."""
-    ka_hi, ka_lo = keys_a
-    kb_hi, kb_lo = keys_b
+    detectable.
+
+    VMEM budget: the network keeps every plane's (2C, 128) tile plus a few
+    temporaries live; the scoped-vmem grant scales with plane count and is
+    capped at 120 MiB (v5e has 128 MiB physical) — deep-key unions at
+    C=1024 sit near the cap, so prefer packing keys into fewer words
+    before raising C."""
+    n_keys = len(keys_a)
     n_vals = len(vals_a)
-    assert n_vals == len(vals_b)
-    c, lanes = ka_hi.shape
+    assert n_keys == len(keys_b) and n_vals == len(vals_b)
+    assert n_keys >= 1
+    c, lanes = keys_a[0].shape
     assert c & (c - 1) == 0, f"capacity {c} must be a power of two"
     assert lanes % LANES == 0, f"lane count {lanes} must be a multiple of {LANES}"
     out = out_size if out_size is not None else 2 * c
@@ -385,26 +393,50 @@ def sorted_union_columnar_fused_lex2(
     in_spec = pl.BlockSpec((c, LANES), lambda i: (0, i))
     out_spec = pl.BlockSpec((out, LANES), lambda i: (0, i))
     nu_spec = pl.BlockSpec((1, LANES), lambda i: (0, i))
+    n_planes = n_keys + n_vals
     outs = pl.pallas_call(
-        _make_lex2_union_kernel(n_vals),
+        _make_lexn_union_kernel(n_keys, n_vals),
         grid=grid,
-        in_specs=[in_spec] * (4 + 2 * n_vals),
-        out_specs=[out_spec] * (2 + n_vals) + [nu_spec],
-        out_shape=[jax.ShapeDtypeStruct((out, lanes), jnp.int32)] * (2 + n_vals)
+        in_specs=[in_spec] * (2 * n_planes),
+        out_specs=[out_spec] * n_planes + [nu_spec],
+        out_shape=[jax.ShapeDtypeStruct((out, lanes), jnp.int32)] * n_planes
         + [jax.ShapeDtypeStruct((1, lanes), jnp.int32)],
         interpret=interpret,
+        # a LIMIT, not a reservation: grant near-physical (v5e: 128 MiB) so
+        # deep-key plane sets compile; Mosaic errors loudly if the network
+        # genuinely cannot fit, and the fix is fewer key words or smaller C
         compiler_params=None if interpret else pltpu.CompilerParams(
-            vmem_limit_bytes=112 * 1024 * 1024,
+            vmem_limit_bytes=120 << 20,
         ),
     )(
-        ka_hi,
-        ka_lo,
+        *keys_a,
         *vals_a,
-        jnp.flip(kb_hi, axis=0),
-        jnp.flip(kb_lo, axis=0),
+        *(jnp.flip(k, axis=0) for k in keys_b),
         *(jnp.flip(v, axis=0) for v in vals_b),
     )
-    return (outs[0], outs[1]), tuple(outs[2 : 2 + n_vals]), outs[2 + n_vals][0]
+    return (
+        tuple(outs[:n_keys]),
+        tuple(outs[n_keys:n_planes]),
+        outs[n_planes][0],
+    )
+
+
+def sorted_union_columnar_fused_lex2(
+    keys_a,          # (hi, lo): pair of int32[C, L], per-lane sorted asc
+    vals_a,          # tuple of int32[C, L] value planes
+    keys_b,
+    vals_b,
+    out_size: int | None = None,
+    interpret: bool = False,
+):
+    """The two-word special case of sorted_union_columnar_fused_lexn — the
+    OpLog fast path (crdt_tpu.models.oplog_columnar).  Returns
+    ((hi, lo), vals_tuple, n_unique[L])."""
+    keys, vals, nu = sorted_union_columnar_fused_lexn(
+        tuple(keys_a), tuple(vals_a), tuple(keys_b), tuple(vals_b),
+        out_size=out_size, interpret=interpret,
+    )
+    return (keys[0], keys[1]), vals, nu
 
 
 def _dedupe_and_compact(keys, vals, combine, out_size):

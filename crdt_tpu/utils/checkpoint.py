@@ -43,11 +43,16 @@ def _interner_load(strings: list, interner) -> None:
         interner.intern(s)
 
 
-def save_node(path: str, node) -> None:
+def save_node(path: str, node, set_node=None) -> None:
     """Snapshot a ReplicaNode: op-tensor columns + interner tables + the
-    raw command map (the gossip-serving source of truth)."""
+    raw command map (the gossip-serving source of truth).  ``set_node``
+    (a crdt_tpu.api.setnode.SetNode) adds the daemon's set-lattice section
+    — its host op records + GC floor, from which the device table is
+    rebuilt on restore."""
     p = pathlib.Path(path)
     p.mkdir(parents=True, exist_ok=True)
+    if set_node is not None:
+        (p / "set.json").write_text(json.dumps(set_node.to_snapshot()))
     cols = {
         name: np.asarray(getattr(node.log, name))
         for name in ("ts", "rid", "seq", "key", "val", "payload", "is_num")
@@ -70,7 +75,8 @@ def save_node(path: str, node) -> None:
     (p / "meta.json").write_text(json.dumps(meta))
 
 
-def restore_node(path: str, node, allow_rid_change: bool = False) -> None:
+def restore_node(path: str, node, allow_rid_change: bool = False,
+                 set_node=None) -> None:
     """Restore a snapshot into a freshly-constructed ReplicaNode.
 
     ``allow_rid_change=True`` is the boot-incarnation path (see module
@@ -106,6 +112,8 @@ def restore_node(path: str, node, allow_rid_change: bool = False) -> None:
     node._frontier = {int(r): int(s) for r, s in meta.get("frontier", [])}
     node._summary = meta.get("summary", {})
     node._rebuild_indexes_locked()  # delta indexes + summary-cache invalidation
+    if set_node is not None and (p / "set.json").exists():
+        set_node.from_snapshot(json.loads((p / "set.json").read_text()))
 
 
 # ---- crash-safe versioned snapshots + boot incarnations ---------------------
@@ -121,7 +129,7 @@ def _replace_file(path: pathlib.Path, data: str) -> None:
     os.replace(tmp, path)
 
 
-def save_node_atomic(root: str, node) -> str:
+def save_node_atomic(root: str, node, set_node=None) -> str:
     """Snapshot ``node`` into a fresh versioned directory under ``root``
     and atomically repoint LATEST at it — a SIGKILL at ANY instant leaves
     either the previous complete snapshot or the new complete snapshot as
@@ -143,7 +151,7 @@ def save_node_atomic(root: str, node) -> str:
     staging = rootp / f".staging-{os.getpid()}-{n}"
     shutil.rmtree(staging, ignore_errors=True)  # orphan from a past crash
     with node._lock:
-        save_node(str(staging), node)
+        save_node(str(staging), node, set_node=set_node)
     final = rootp / f"snap-{n:08d}"
     os.rename(staging, final)  # same fs: atomic
     _replace_file(latest, final.name)
@@ -156,7 +164,8 @@ def save_node_atomic(root: str, node) -> str:
     return str(final)
 
 
-def load_latest_node(root: str, node, allow_rid_change: bool = True) -> bool:
+def load_latest_node(root: str, node, allow_rid_change: bool = True,
+                     set_node=None) -> bool:
     """Restore the newest complete snapshot under ``root`` into ``node``;
     False when none exists (fresh boot)."""
     rootp = pathlib.Path(root)
@@ -164,7 +173,8 @@ def load_latest_node(root: str, node, allow_rid_change: bool = True) -> bool:
     if not latest.exists():
         return False
     snap = rootp / latest.read_text().strip()
-    restore_node(str(snap), node, allow_rid_change=allow_rid_change)
+    restore_node(str(snap), node, allow_rid_change=allow_rid_change,
+                 set_node=set_node)
     return True
 
 

@@ -49,6 +49,19 @@ class ClusterConfig:
     # wire-compatible with a Go reference peer (see crdt_tpu.api.node
     # FRONTIER_KEY); leave at 0 for mixed deployments.
     compact_every: int = 0
+    # run a set-lattice GC barrier (crdt_tpu.api.setnode) every N gossip
+    # rounds from the coordinator (0 = only explicit /admin/set_barrier).
+    # Independent of compact_every: the set surface has its own wire, so
+    # set GC stays available even when KV compaction must be off (e.g.
+    # go_compat_gossip mixed fleets — the /set routes are not part of the
+    # Go-visible surface).
+    set_collect_every: int = 0
+    # emit full-dump gossip with the reference's bare integer-ms keys so an
+    # ORIGINAL Go peer can pull from this fleet without killing its gossip
+    # loop (quirk §0.1.8).  Lossy by the reference's own rule: same-ms ops
+    # collapse last-writer-per-ms (§0.1.2).  Requires compact_every=0 and
+    # (for crdt_tpu peers) delta_gossip=True — see crdt_tpu.api.node.
+    go_compat_gossip: bool = False
 
     def ports(self) -> List[int]:
         return [self.base_port + i for i in range(self.n_replicas)]

@@ -124,3 +124,93 @@ def test_random_crash_schedule(request):
     assert report.checkpoints >= 1, report
     assert report.writes_accepted > 0
     assert report.rounds_to_converge >= 0
+    # the set workload must be exercised by the same schedule (round 3)
+    assert report.set_adds >= 1, report
+
+
+def _set_add(runner, slot, elem):
+    d = runner.daemons[slot]
+    code, body = _http(d.url + "/set/add", "POST", {"elem": elem})
+    assert code == 200, body
+    got = json.loads(body)
+    rid = d.wire_rid
+    seq = runner.set_accepted_per_boot.get(rid, 0)
+    assert (got["rid"], got["seq"]) == (rid, seq)
+    runner.set_accepted_per_boot[rid] = seq + 1
+    runner.set_adds.append((rid, seq, elem))
+
+
+def _set_remove(runner, slot, elem):
+    d = runner.daemons[slot]
+    code, body = _http(d.url + "/set/remove", "POST", {"elem": elem})
+    assert code == 200, body
+    got = json.loads(body)
+    assert got["removed"], f"observed-remove found no live tag for {elem}"
+    rid = d.wire_rid
+    seq = runner.set_accepted_per_boot.get(rid, 0)
+    runner.set_accepted_per_boot[rid] = seq + 1
+    runner.set_removes.append(
+        (rid, seq, [tuple(map(int, t)) for t in got["tags"]])
+    )
+
+
+def _set_pull_all(runner):
+    for d in runner.daemons:
+        if not d.running:
+            continue
+        for peer in d.peer_urls:
+            code, body = _http(d.url + "/admin/set_pull", "POST",
+                               {"peer": peer})
+            assert code == 200, body
+
+
+def _set_barrier(runner):
+    code, body = _http(runner.daemons[0].url + "/admin/set_barrier",
+                       "POST", {})
+    assert code == 200, body
+    return json.loads(body)["floor"]
+
+
+def test_stale_floor_restore_under_gc_barriers(fleet):
+    """The round-3 scripted interleaving: a node restored from a PRE-GC-
+    barrier snapshot (stale floor, collected rows still live in it) rejoins
+    a fleet whose GC barriers keep advancing — no resurrection, no lost
+    removal, floors stay chained (S1-S3)."""
+    r = fleet
+    for slot in range(3):
+        _set_add(r, slot, f"e{slot}")
+    _set_pull_all(r)
+    _set_pull_all(r)  # full mesh: everyone holds all three adds
+    # node 2 checkpoints NOW: pre-barrier snapshot (floor = empty, and it
+    # still holds e0 LIVE with no knowledge of the upcoming removal)
+    code, body = _http(r.daemons[2].url + "/admin/checkpoint", "POST", {})
+    assert code == 200, body
+    r.set_ckpt_watermark[r.daemons[2].wire_rid] = (
+        r.set_accepted_per_boot.get(r.daemons[2].wire_rid, 0)
+    )
+    # remove e0 and run a GC barrier that COLLECTS it fleet-wide
+    _set_remove(r, 0, "e0")
+    _set_pull_all(r)
+    _set_pull_all(r)
+    floor = _set_barrier(r)
+    assert floor, "converged fleet: the GC barrier must fold"
+    r.last_set_floor = {int(k): int(v) for k, v in floor.items()}
+    # SIGKILL node 2, restore from the stale snapshot into the live fleet:
+    # its restored table holds e0 live under a floor the fleet has passed
+    r.daemons[2].sigkill()
+    r.daemons[2].spawn()
+    code, body = _http(r.daemons[2].url + "/set")
+    assert code == 200
+    assert "e0" in json.loads(body)["members"], (
+        "restored pre-barrier snapshot must still hold the collected tag"
+    )
+    # barriers keep running while the stale node rejoins (skip or fold,
+    # never 500), then the full-payload suppression kills the zombie tag
+    _set_barrier(r)
+    _set_pull_all(r)
+    _set_barrier(r)
+    report = r.heal_and_check()
+    assert report.set_ops_lost == 0  # everything was checkpointed/gossiped
+    members = json.loads(_http(r.daemons[2].url + "/set")[1])["members"]
+    assert "e0" not in members, "collected tag resurrected (S1c)"
+    assert set(members) == {"e1", "e2"}

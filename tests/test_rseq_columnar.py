@@ -1,0 +1,209 @@
+"""Columnar RSeq fast path (crdt_tpu.models.rseq_columnar) vs the generic
+row-major join — interpret mode on CPU; the compiled Mosaic path is covered
+by benches/hw_selftest.py.  Ground truth: vmapped rseq.join_checked over
+the same stacked states."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from crdt_tpu.models import rseq, rseq_columnar as rc
+
+CAP = 64
+
+
+def _edited_state(rng, rid_base, base_state=None, n_edits=12, cap=CAP):
+    w = rseq.SeqWriter(
+        rseq.empty(cap) if base_state is None else base_state, rid=rid_base
+    )
+    for _ in range(n_edits):
+        n = len(w.to_list())
+        if n and rng.random() < 0.35:
+            w.delete_at(int(rng.integers(0, n)))
+        else:
+            w.insert_at(int(rng.integers(0, n + 1)), int(rng.integers(0, 500)))
+    return w.state
+
+
+def _swarm(rng, r=4, rid_base=10, base=None, cap=CAP):
+    """[R, C, 4D] batched RSeq: concurrent branches off a shared base (so
+    cross-replica duplicate keys AND one-sided tombstones are plentiful).
+
+    Writer rids must be globally unique across every state that will ever
+    be joined — two writers minting the same (rid, seq) for different
+    content would violate the op-identity invariant every join in the
+    framework (generic included) is built on."""
+    if base is None:
+        base = _edited_state(rng, rid_base=0, n_edits=8, cap=cap)
+    states = [
+        _edited_state(rng, rid_base=rid_base + k, base_state=base, cap=cap)
+        for k in range(r)
+    ]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def _assert_rseq_equal(a: rseq.RSeq, b: rseq.RSeq):
+    np.testing.assert_array_equal(np.asarray(a.keys), np.asarray(b.keys))
+    np.testing.assert_array_equal(np.asarray(a.elem), np.asarray(b.elem))
+    np.testing.assert_array_equal(
+        np.asarray(a.removed), np.asarray(b.removed)
+    )
+
+
+def test_stack_unstack_roundtrip():
+    rng = np.random.default_rng(0)
+    batch = _swarm(rng)
+    col = rc.stack(batch)
+    assert col.depth == rseq.DEPTH and col.lanes == 4
+    _assert_rseq_equal(rc.unstack(col), batch)
+
+
+def test_stack_single_state():
+    rng = np.random.default_rng(1)
+    s = _edited_state(rng, rid_base=3)
+    col = rc.stack(s)
+    back = rc.unstack(col)
+    assert rseq.to_list(jax.tree.map(lambda x: x[0], back)) == rseq.to_list(s)
+
+
+def test_pack_order_matches_row_order():
+    """Packed-word lexicographic order must equal the 4D-column order —
+    the whole point of the layout.  The stacked planes must already be
+    per-lane sorted because the row-major rows were."""
+    rng = np.random.default_rng(2)
+    col = rc.stack(_swarm(rng))
+    keys = np.asarray(col.keys)  # (3D, C, R)
+    for lane in range(keys.shape[2]):
+        rows = [tuple(keys[:, i, lane]) for i in range(keys.shape[1])]
+        assert rows == sorted(rows), f"lane {lane} not sorted after pack"
+
+
+@pytest.mark.parametrize("seed", [3, 4, 5])
+def test_columnar_merge_matches_generic_join(seed):
+    rng = np.random.default_rng(seed)
+    base = _edited_state(rng, rid_base=0, n_edits=8)
+    a = _swarm(rng, rid_base=10, base=base)
+    b = _swarm(rng, rid_base=20, base=base)  # disjoint writers, shared base
+    ca, cb = rc.stack(a), rc.stack(b)
+    if ca.seq_bits != cb.seq_bits:
+        common = min(ca.seq_bits, cb.seq_bits)
+        ca, cb = rc.stack(a, seq_bits=common), rc.stack(b, seq_bits=common)
+    got, nu = rc.merge_checked(ca, cb, interpret=True)
+    want, wnu = jax.vmap(rseq.join_checked)(a, b)
+    _assert_rseq_equal(rc.unstack(got), want)
+    np.testing.assert_array_equal(np.asarray(nu), np.asarray(wnu))
+
+
+def test_one_sided_tombstone_survives_the_kernel():
+    """The OR-combine-on-punch rule: a removal held by only one side of a
+    duplicate key must survive whichever copy the network keeps."""
+    rng = np.random.default_rng(6)
+    base = _edited_state(rng, rid_base=0, n_edits=10)
+    wa = rseq.SeqWriter(base, rid=1)
+    wb = rseq.SeqWriter(base, rid=2)
+    wa.delete_at(0)          # a tombstones an element b still holds live
+    wb.insert_at(0, 999)
+    a = jax.tree.map(lambda *x: jnp.stack(x), wa.state, wa.state)
+    b = jax.tree.map(lambda *x: jnp.stack(x), wb.state, wb.state)
+    common = min(rc.stack(a).seq_bits, rc.stack(b).seq_bits)
+    got, _ = rc.merge_checked(
+        rc.stack(a, seq_bits=common), rc.stack(b, seq_bits=common),
+        interpret=True,
+    )
+    want = rseq.join(wa.state, wb.state)
+    lst = rseq.to_list(jax.tree.map(lambda x: x[0], rc.unstack(got)))
+    assert lst == rseq.to_list(want)
+
+
+def test_converge_matches_generic(seed=7):
+    rng = np.random.default_rng(seed)
+    state = _swarm(rng, r=4)
+    col = rc.stack(state)
+    conv, max_nu = rc.converge_checked(col, interpret=True)
+    # generic ground truth: fold all replicas pairwise
+    states = [jax.tree.map(lambda x: x[i], state) for i in range(4)]
+    top = states[0]
+    for s in states[1:]:
+        top = rseq.join(top, s)
+    got = rc.unstack(conv)
+    for i in range(4):
+        one = jax.tree.map(lambda x: x[i], got)
+        assert rseq.to_list(one) == rseq.to_list(top)
+    assert int(max_nu) <= CAP
+
+
+def test_converge_respects_alive_mask():
+    rng = np.random.default_rng(8)
+    state = _swarm(rng, r=4)
+    col = rc.stack(state)
+    alive = jnp.asarray([True, True, False, True])
+    conv = rc.converge(col, alive, interpret=True)
+    got = rc.unstack(conv)
+    # the dead lane keeps its stale table
+    dead = jax.tree.map(lambda x: x[2], got)
+    orig = jax.tree.map(lambda x: x[2], state)
+    assert rseq.to_list(dead) == rseq.to_list(orig)
+    # alive lanes agree with the alive-only LUB (dead contributes nothing)
+    states = [jax.tree.map(lambda x: x[i], state) for i in (0, 1, 3)]
+    top = states[0]
+    for s in states[1:]:
+        top = rseq.join(top, s)
+    for i in (0, 1, 3):
+        one = jax.tree.map(lambda x: x[i], got)
+        assert rseq.to_list(one) == rseq.to_list(top)
+
+
+def test_gossip_round_matches_generic():
+    rng = np.random.default_rng(9)
+    state = _swarm(rng, r=4)
+    col = rc.stack(state)
+    peers = jnp.asarray([1, 2, 3, 0], jnp.int32)
+    got = rc.unstack(rc.gossip_round(col, peers, interpret=True))
+    for i, p in enumerate([1, 2, 3, 0]):
+        a = jax.tree.map(lambda x: x[i], state)
+        b = jax.tree.map(lambda x: x[p], state)
+        want = rseq.join(a, b)
+        one = jax.tree.map(lambda x: x[i], got)
+        assert rseq.to_list(one) == rseq.to_list(want)
+
+
+def test_overflow_stays_detectable():
+    """Two disjoint near-full tables: the true union exceeds capacity and
+    n_unique must say so (pre-truncation count)."""
+    cap = 16
+
+    def appended(rid, n):
+        w = rseq.SeqWriter(rseq.empty(cap), rid=rid)
+        for i in range(n):
+            w.append(i)
+        return w.state
+
+    a = appended(1, 12)
+    b = appended(2, 12)  # disjoint writers: union = 24 rows > 16
+    ab = jax.tree.map(lambda *x: jnp.stack(x), a, a)
+    bb = jax.tree.map(lambda *x: jnp.stack(x), b, b)
+    common = min(rc.stack(ab).seq_bits, rc.stack(bb).seq_bits)
+    _, nu = rc.merge_checked(
+        rc.stack(ab, seq_bits=common), rc.stack(bb, seq_bits=common),
+        interpret=True,
+    )
+    _, wnu = rseq.join_checked(a, b)
+    assert int(nu[0]) == int(wnu) > cap
+
+
+def test_stack_rejects_out_of_budget_seq():
+    w = rseq.SeqWriter(rseq.empty(CAP), rid=1)
+    for i in range(6):
+        w.append(i)  # seqs 0..5 — a 2-bit seq field cannot hold 5
+    batch = jax.tree.map(lambda x: x[None], w.state)
+    with pytest.raises(ValueError, match="exceeds the"):
+        rc.stack(batch, seq_bits=2)
+
+
+def test_merge_rejects_mismatched_layouts():
+    rng = np.random.default_rng(12)
+    state = _swarm(rng)
+    ca = rc.stack(state, seq_bits=20)
+    cb = rc.stack(state, seq_bits=21)
+    with pytest.raises(ValueError, match="pack layouts"):
+        rc.merge_checked(ca, cb)

@@ -210,6 +210,37 @@ def test_gc_barrier_refuses_on_overflow():
     assert int(orset.size(g2.inner)) == 12  # all live, nothing collected
 
 
+def test_join_checked_rejects_mismatched_shapes():
+    """Advisor round 2: mixed capacities/layouts must raise loudly (the
+    bare sorted_union assert vanishes under python -O, and the capacity
+    slice would otherwise make the join silently asymmetric)."""
+    a = tomb_gc.wrap(orset.empty(16), W)
+    b = tomb_gc.wrap(orset.empty(32), W)
+    with pytest.raises(ValueError, match="equal capacities|key layouts"):
+        tomb_gc.join_checked(a, b, AD)
+    c = tomb_gc.wrap(orset.empty(16), W + 1)
+    with pytest.raises(ValueError, match="writer counts"):
+        tomb_gc.join_checked(a, c, AD)
+    # mixed-depth RSeq states carry different key-column counts
+    ra = tomb_gc.wrap(rseq.empty(16), W)
+    rb = tomb_gc.wrap(rseq.widen(rseq.empty(16), rseq.DEPTH + 1), W)
+    with pytest.raises(ValueError, match="key layouts"):
+        tomb_gc.join_checked(ra, rb, rseq.GC_ADAPTER)
+
+
+def test_join_refuses_overflow():
+    """Advisor round 2: the public convenience ``join`` must raise on
+    capacity overflow instead of silently truncating (truncation breaks
+    per-writer seq contiguity — permanent data loss under GC)."""
+    a = tomb_gc.wrap(orset.empty(8), W)
+    b = tomb_gc.wrap(orset.empty(8), W)
+    for i in range(6):
+        a = _add(a, i, 0, i)
+        b = _add(b, 10 + i, 1, i)
+    with pytest.raises(tomb_gc.GcOverflow, match="12 rows"):
+        tomb_gc.join(a, b, AD)
+
+
 def test_next_seq_is_floor_aware():
     """After GC collects a writer's rows, the table max understates the used
     seq range; next_seq must resume above the floor instead."""
