@@ -395,6 +395,8 @@ class NodeHost:
         if checkpoint_dir:
             from crdt_tpu.utils import checkpoint as ckpt
 
+            # (restore boots alive — the checkpoint layer treats the alive
+            # flag as fault-injection state, not durable data)
             self.restored = ckpt.load_latest_node(
                 checkpoint_dir, self.node, set_node=self.set_node
             )

@@ -542,8 +542,15 @@ def _tombstone_tags(s: orset.ORSet, tags) -> orset.ORSet:
 
     from crdt_tpu.utils.constants import SENTINEL
 
-    rid = jnp.asarray([t[0] for t in tags], jnp.int32)
-    seq = jnp.asarray([t[1] for t in tags], jnp.int32)
+    # pad the tag list to a power of two: jit shapes are static, so an
+    # unpadded list compiles one XLA program PER DISTINCT COUNT — a
+    # snapshot replay with many remove ops paid seconds of compiles per
+    # length and could blow a daemon's health deadline.  (-1, -1) matches
+    # nothing: real rows have rid >= 0, padding rows rid = SENTINEL.
+    n = max(8, 1 << (len(tags) - 1).bit_length())
+    padded = list(tags) + [(-1, -1)] * (n - len(tags))
+    rid = jnp.asarray([t[0] for t in padded], jnp.int32)
+    seq = jnp.asarray([t[1] for t in padded], jnp.int32)
     hit = (
         (s.rid[:, None] == rid[None, :])
         & (s.seq[:, None] == seq[None, :])

@@ -121,7 +121,7 @@ class Daemon:
         """The writer id of the CURRENT boot (matches bump_incarnation)."""
         return self.slot + RID_STRIDE * (self.boots - 1)
 
-    def spawn(self, wait_s: float = 30.0) -> None:
+    def spawn(self, wait_s: float = 90.0) -> None:
         assert self.proc is None or self.proc.poll() is not None
         argv = [
             sys.executable, "-m", "crdt_tpu", "--daemon",

@@ -82,6 +82,35 @@ def fit_seq_bits(n_writers: int, max_seq: int) -> int:
     return seq_bits
 
 
+def plan(states: rseq.RSeq, seq_bits: int | None = None):
+    """Auto-selection for RSeq swarms, mirroring oplog_engine.plan: stage
+    into the columnar lexN engine whenever the identity budgets allow,
+    fall back LOUDLY (an ``oplog_engine.EngineFallback`` warning naming
+    the violated budget) to the row-major generic path otherwise.
+
+    Returns ``(ColumnarRSeq, None)`` on the fast path or ``(None, reason)``
+    on fallback — callers keep the batched row-major state and drive it
+    through ``jax.vmap(rseq.join)`` / swarm.converge as before."""
+    import warnings
+
+    from crdt_tpu.models.oplog_engine import EngineFallback
+
+    try:
+        cap = states.keys.shape[-2]
+        if cap & (cap - 1):
+            raise ValueError(
+                f"capacity {cap} is not a power of two (bitonic network)"
+            )
+        return stack(states, seq_bits=seq_bits), None
+    except ValueError as e:
+        warnings.warn(
+            f"RSeq swarm fell back to the generic engine: {e}",
+            EngineFallback,
+            stacklevel=2,
+        )
+        return None, str(e)
+
+
 def stack(states: rseq.RSeq, seq_bits: int | None = None) -> ColumnarRSeq:
     """Stage a batched [R, C, 4D] RSeq (or a single [C, 4D] state) into
     columnar planes.  Host-side: validates every identity field against
@@ -264,6 +293,25 @@ def lub_lane(
     return work, max_nu
 
 
+def _broadcast_top(
+    col: ColumnarRSeq, top: ColumnarRSeq, alive: jax.Array | None
+) -> ColumnarRSeq:
+    """Broadcast a one-lane LUB over the alive lanes of ``col`` (dead
+    lanes keep their stale tables) — shared by the single-device and
+    sharded converge paths so their dead-lane semantics cannot diverge."""
+    out = jax.tree.map(
+        lambda t, x: jnp.broadcast_to(t[..., :1], x.shape), top, col
+    )
+    if alive is None:
+        return out
+    return ColumnarRSeq(
+        keys=jnp.where(alive[None, None, :], out.keys, col.keys),
+        elem=jnp.where(alive[None, :], out.elem, col.elem),
+        removed=jnp.where(alive[None, :], out.removed, col.removed),
+        seq_bits=col.seq_bits,
+    )
+
+
 def converge_checked(
     col: ColumnarRSeq, alive: jax.Array | None = None, interpret: bool = False
 ):
@@ -273,23 +321,9 @@ def converge_checked(
     some pairwise union truncated."""
     from crdt_tpu.utils.tracing import trace_region
 
-    lanes = col.lanes
     with trace_region("rseq_columnar.converge"):
         work, max_nu = lub_lane(col, alive, interpret=interpret)
-        top = jax.tree.map(
-            lambda x: jnp.broadcast_to(
-                x[..., :1], x.shape[:-1] + (lanes,)
-            ),
-            work,
-        )
-        if alive is not None:
-            top = ColumnarRSeq(
-                keys=jnp.where(alive[None, None, :], top.keys, col.keys),
-                elem=jnp.where(alive[None, :], top.elem, col.elem),
-                removed=jnp.where(alive[None, :], top.removed, col.removed),
-                seq_bits=col.seq_bits,
-            )
-        return top, max_nu
+        return _broadcast_top(col, work, alive), max_nu
 
 
 def converge(
@@ -297,6 +331,75 @@ def converge(
 ) -> ColumnarRSeq:
     out, _ = converge_checked(col, alive, interpret=interpret)
     return out
+
+
+def sharded_converge(
+    mesh,
+    depth: int = rseq.DEPTH,
+    seq_bits: int = 20,
+    axis: str = "replica",
+    interpret: bool | None = None,
+):
+    """Multi-chip columnar RSeq convergence: the lane (replica) axis
+    sharded over a device mesh, the fused lexN kernel doing every merge —
+    the sequence-CRDT sibling of oplog_columnar.sharded_converge, same
+    three-phase program:
+
+      1. each device tree-reduces its local lane shard to a one-lane LUB
+         (lub_lane — all fused-kernel merges, no cross-device traffic);
+      2. one ``all_gather`` ships the P single-lane LUBs over ICI/DCN —
+         the ONLY collective, moving (3·D + 2) planes × C rows × P lanes;
+      3. each device reduces the gathered lanes to the global LUB and
+         broadcasts it over its local alive lanes.
+
+    Build once per mesh; the returned jitted ``step(col, alive)`` returns
+    ``(col, max_n_unique)``.  ``interpret`` defaults to True off TPU."""
+    from jax.sharding import PartitionSpec as P
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def local_step(keys, elem, removed, alive):
+        col = ColumnarRSeq(keys=keys, elem=elem, removed=removed,
+                           seq_bits=seq_bits)
+        local_lub, nu_local = lub_lane(col, alive, interpret=interpret)
+        gathered = jax.tree.map(
+            lambda x: jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True),
+            local_lub,
+        )
+        top, nu_global = lub_lane(gathered, interpret=interpret)
+        out = _broadcast_top(col, top, alive)
+        # per-device nu values differ: pmax keeps the replicated out_spec
+        # truthful (same reasoning as oplog_columnar.sharded_converge)
+        max_nu = jax.lax.pmax(jnp.maximum(nu_local, nu_global), axis)
+        return out.keys, out.elem, out.removed, max_nu
+
+    shmapped = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(None, None, axis), P(None, axis), P(None, axis),
+                  P(axis)),
+        out_specs=(P(None, None, axis), P(None, axis), P(None, axis), P()),
+        check_vma=False,  # pallas out_shapes carry no varying-axes note
+    )
+
+    @jax.jit
+    def step(col: ColumnarRSeq, alive: jax.Array):
+        if col.seq_bits != seq_bits or col.depth != depth:
+            raise ValueError(
+                f"state (depth={col.depth}, seq_bits={col.seq_bits}) does "
+                f"not match this step (depth={depth}, seq_bits={seq_bits})"
+            )
+        keys, elem, removed, max_nu = shmapped(
+            col.keys, col.elem, col.removed, alive
+        )
+        return (
+            ColumnarRSeq(keys=keys, elem=elem, removed=removed,
+                         seq_bits=seq_bits),
+            max_nu,
+        )
+
+    return step
 
 
 def gossip_round(

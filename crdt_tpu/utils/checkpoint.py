@@ -102,7 +102,12 @@ def restore_node(path: str, node, allow_rid_change: bool = False,
             val=jnp.asarray(z["val"]), payload=jnp.asarray(z["payload"]),
             is_num=jnp.asarray(z["is_num"]),
         )
-    node.alive = meta["alive"]
+    # the alive flag is fault-injection state (the reference's /condition
+    # toggle), NOT durable data: a snapshot taken while soft-dead must not
+    # make every future restore serve 502s (a restored daemon that can
+    # never pass its own health check — the crash soak found this).  A
+    # (re)booted replica is alive; operators re-inject faults explicitly.
+    node.alive = True
     if not rid_changed:
         node._seq.count = meta["seq"]
     node.clock.epoch_ms = meta["epoch_ms"]
