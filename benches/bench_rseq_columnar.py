@@ -137,6 +137,11 @@ def timed(fn, k_small, k_large, reps=3):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--capacity", type=int, default=1024)
+    ap.add_argument("--depth", type=int, default=None,
+                    help="path-key depth (default rseq.DEPTH=6; shallower "
+                         "depths cut the kernel's plane count — the "
+                         "C=1024 full-depth 20-plane monolith exceeds the "
+                         "tunnel compile server's limits)")
     ap.add_argument("--merge-lanes", type=int, default=1024)
     ap.add_argument("--converge-replicas", type=int, default=512)
     ap.add_argument("--bank", type=int, default=2)
@@ -153,10 +158,12 @@ def main():
 
     if args.stage in ("all", "merge"):
         lanes = args.merge_lanes
-        a = make_swarm_planes(0, c, lanes)
+        d = args.depth or rseq.DEPTH
+        a = make_swarm_planes(0, c, lanes, depth=d)
         bank = jax.tree.map(
             lambda *xs: jnp.stack(xs),
-            *[make_swarm_planes(1 + i, c, lanes) for i in range(args.bank)],
+            *[make_swarm_planes(1 + i, c, lanes, depth=d)
+              for i in range(args.bank)],
         )
         print(f"compiling columnar lexN merge (C={c}, R={lanes}, "
               f"{a.keys.shape[0]}+2 planes)...", flush=True)
@@ -179,7 +186,7 @@ def main():
 
     if args.stage in ("all", "converge"):
         r = args.converge_replicas
-        col = make_swarm_planes(99, c, r)
+        col = make_swarm_planes(99, c, r, depth=args.depth or rseq.DEPTH)
         print(f"compiling columnar lexN converge (R={r}, C={c})...",
               flush=True)
         per_c = timed(lambda k: chained_converge_columnar(col, k),

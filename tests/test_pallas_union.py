@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from crdt_tpu.ops import pack, pallas_union
+from crdt_tpu.ops import pack, pallas_union, sorted_union as su
 from crdt_tpu.utils.constants import SENTINEL_PY
 
 
@@ -179,3 +179,63 @@ def test_fused_empty_and_degenerate():
         ka, va, ka, va, out_size=c, interpret=True)
     np.testing.assert_array_equal(np.asarray(ko), np.asarray(ka))
     np.testing.assert_array_equal(np.asarray(vo), np.asarray(va))
+
+
+def _lexn_cols(rng, c, lanes, n_keys, n_vals, or_plane=None):
+    """Per-lane sorted unique n_keys-word rows + n value planes; plane
+    ``or_plane`` (if given) is a random 0/1 monotone flag (tombstone-like),
+    every other value plane is key-determined."""
+    keys = [np.full((c, lanes), SENTINEL_PY, np.int32) for _ in range(n_keys)]
+    vals = [np.zeros((c, lanes), np.int32) for _ in range(n_vals)]
+    for j in range(lanes):
+        n = int(rng.integers(0, c + 1))
+        rows = sorted({
+            tuple(int(rng.integers(0, 6)) for _ in range(n_keys))
+            for _ in range(n)
+        })
+        for r, row in enumerate(rows):
+            for k in range(n_keys):
+                keys[k][r, j] = row[k]
+            for i, v in enumerate(vals):
+                if i == or_plane:
+                    v[r, j] = int(rng.integers(0, 2))
+                else:
+                    v[r, j] = sum(row) * 31 + i + 1
+    return ([jnp.asarray(k) for k in keys], [jnp.asarray(v) for v in vals])
+
+
+@pytest.mark.parametrize("n_keys", [1, 3, 5])
+def test_lexn_union_matches_generic(n_keys):
+    """The N-word fused kernel at in-between key counts (1, 3, 5 — the
+    shipped paths are 2 and 18), including the OR-combine-on-punch rule
+    for a monotone flag plane whose duplicate copies DIFFER."""
+    rng = np.random.default_rng(40 + n_keys)
+    c, lanes, n_vals = 32, 128, 2
+    ka, va = _lexn_cols(rng, c, lanes, n_keys, n_vals, or_plane=1)
+    kb, vb = _lexn_cols(rng, c, lanes, n_keys, n_vals, or_plane=1)
+    ko, vo, nu = pallas_union.sorted_union_columnar_fused_lexn(
+        tuple(ka), tuple(va), tuple(kb), tuple(vb),
+        out_size=c, interpret=True,
+    )
+    for j in range(0, lanes, 23):
+        keys, vals, n = su.sorted_union(
+            tuple(k[:, j] for k in ka),
+            {i: v[:, j] for i, v in enumerate(va)},
+            tuple(k[:, j] for k in kb),
+            {i: v[:, j] for i, v in enumerate(vb)},
+            # plane 0 is key-determined (keep-first == OR); plane 1 is the
+            # monotone flag, where the kernel's OR-on-punch applies
+            combine=lambda x, y: {0: x[0], 1: x[1] | y[1]},
+            out_size=c,
+        )
+        for k in range(n_keys):
+            np.testing.assert_array_equal(
+                np.asarray(keys[k]), np.asarray(ko[k][:, j]),
+                err_msg=f"key {k}",
+            )
+        for i in range(n_vals):
+            np.testing.assert_array_equal(
+                np.asarray(vals[i]), np.asarray(vo[i][:, j]),
+                err_msg=f"val {i}",
+            )
+        assert int(n) == int(nu[j])
