@@ -211,6 +211,16 @@ class ReplicaNode:
             if not self.alive:
                 return False
             ts = self.clock.now_ms() if ts is None else ts
+            if not (0 <= ts < INT32_MAX):
+                # ts == INT32_MAX IS the SENTINEL padding encoding: a row
+                # minted there would be invisible to every sorted-table
+                # path (silent data loss).  ~24.8 days of epoch offset —
+                # restart (or re-epoch) the node before then, loudly.
+                raise ValueError(
+                    f"local timestamp {ts} outside the storable int32 "
+                    f"window [0, {INT32_MAX}) (ts == {INT32_MAX} is the "
+                    "SENTINEL padding encoding)"
+                )
             seq = self._seq.next()
             with self.metrics.timer("write"):
                 self._ingest([(ts, self.rid, seq, dict(cmd))])
@@ -390,7 +400,9 @@ class ReplicaNode:
         for k, cmd in payload.items():
             ts_abs, rid, seq = _parse_wire_key(k)
             ts = ts_abs - epoch  # rebase onto this node's int32 window
-            if not (INT32_MIN <= ts <= INT32_MAX):
+            # strict upper bound: ts == INT32_MAX is the SENTINEL padding
+            # encoding — a row stored there would silently read as a hole
+            if not (INT32_MIN <= ts < INT32_MAX):
                 raise ValueError(
                     f"gossip timestamp {ts_abs} is outside this node's int32 "
                     f"window (epoch {epoch}); reference quirk §0.1.8 made this "

@@ -253,11 +253,19 @@ class SetNode:
         to this node's own knowledge like every compaction surface."""
         with self._lock:
             vv = self._vv_locked()
+            # all-or-nothing adoption: if this node's vv does not dominate
+            # the barrier floor (possible when a SIGKILL + stale-snapshot
+            # restore landed inside the barrier window), adopt NOTHING.  A
+            # per-writer clamp here could mint a floor incomparable with a
+            # sibling's clamped floor, and two incomparable floors turn
+            # gossip between them into 500s until a healthy peer heals
+            # them (advisor round 3).  Skipping is safe: the node catches
+            # up via _adopt_floor_locked on its next pull.
+            if any(s > vv.get(r, -1) for r, s in floor.items()):
+                self.metrics.inc("set_collect_behind")
+                return
             target = {
-                r: min(s, vv.get(r, -1)) for r, s in floor.items()
-            }
-            target = {
-                r: s for r, s in target.items()
+                r: s for r, s in floor.items()
                 if s > self._floor.get(r, -1)
             }
             if not target:

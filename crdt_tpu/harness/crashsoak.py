@@ -38,6 +38,14 @@ Invariants checked at heal time:
                       kills, restores with stale frontiers, and revival
                       merges are all legal schedules (frontier chain rule).
 
+Round 4 adds the SEQUENCE workload (crdt_tpu.api.seqnode: RSeq + path
+keys + tombstone GC over the /seq/* wire) to the same schedule, with
+Q-invariants mirroring the S-invariants below: Q1 durability (converged
+membership == the targeted-remove fold of exactly the vv-surviving seq
+ops, with the same checkpoint/live-writer watermark rules; ORDER is
+checked as fleet-wide agreement — every daemon renders the identical
+list), Q2 floor safety, Q3 no seq pull/collect/barrier ever 500s.
+
 Round 3 adds the SET workload (crdt_tpu.api.setnode: OR-Set + tombstone
 GC + floor-carrying deltas) to the same kill/restore schedule — GC
 barriers race SIGKILLs and snapshot restores, the round-2 verdict's
@@ -90,7 +98,10 @@ def _free_ports(n: int) -> List[int]:
 
 
 def _http(url: str, method: str = "GET", body: Optional[dict] = None,
-          timeout: float = 10.0) -> Tuple[int, bytes]:
+          timeout: float = 30.0) -> Tuple[int, bytes]:
+    # 30 s: a pull that lands on a daemon mid-jit-recompile (a sequence
+    # depth widen re-specializes every seq kernel) can legitimately take
+    # >10 s on the CPU backend; the warmup covers the COMMON shapes only
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(url, data=data, method=method)
     try:
@@ -196,6 +207,13 @@ class CrashReport:
     set_barriers_empty: int = 0
     set_ops_lost: int = 0
     final_members: int = 0
+    seq_inserts: int = 0
+    seq_removes: int = 0
+    seq_pulls: int = 0
+    seq_barriers: int = 0
+    seq_barriers_empty: int = 0
+    seq_ops_lost: int = 0
+    final_len: int = 0
 
     def __str__(self) -> str:
         return (
@@ -209,7 +227,11 @@ class CrashReport:
             f"{self.final_keys} keys; set: {self.set_adds}+{self.set_removes}"
             f" ops, {self.set_pulls} pulls, {self.set_barriers} GC barriers "
             f"(+{self.set_barriers_empty} empty), {self.set_ops_lost} "
-            f"crash-lost, {self.final_members} members"
+            f"crash-lost, {self.final_members} members; seq: "
+            f"{self.seq_inserts}+{self.seq_removes} ops, {self.seq_pulls} "
+            f"pulls, {self.seq_barriers} GC barriers "
+            f"(+{self.seq_barriers_empty} empty), {self.seq_ops_lost} "
+            f"crash-lost, len {self.final_len}"
         )
 
 
@@ -250,6 +272,13 @@ class CrashSoakRunner:
         self.set_ckpt_watermark: Dict[int, int] = {}
         self.last_set_floor: Dict[int, int] = {}      # S2 monotonicity bar
         self.set_elems = [f"s{i}" for i in range(n_keys)]
+        # sequence-lattice oracle: inserts (rid, seq, elem) with fleet-
+        # unique elems, removes (rid, seq, target identity)
+        self.seq_inserts: List[Tuple[int, int, str]] = []
+        self.seq_removes: List[Tuple[int, int, Tuple[int, int]]] = []
+        self.seq_accepted_per_boot: Dict[int, int] = {}
+        self.seq_ckpt_watermark: Dict[int, int] = {}
+        self.last_seq_floor: Dict[int, int] = {}      # Q2 monotonicity bar
         self.report = CrashReport()
 
     # ---- schedule actions ----
@@ -303,6 +332,13 @@ class CrashSoakRunner:
                 got = json.loads(body)
                 if got["removed"]:
                     seq = self.set_accepted_per_boot.get(rid, 0)
+                    # mirror the add path: a mint divergence must fail HERE,
+                    # not surface later as a confusing S1b/S1c failure far
+                    # from the cause (advisor round 3)
+                    assert (got["rid"], got["seq"]) == (rid, seq), (
+                        f"S1: daemon minted {got['rid']}:{got['seq']} for a "
+                        f"remove, oracle expected {rid}:{seq}"
+                    )
                     self.set_accepted_per_boot[rid] = seq + 1
                     self.set_removes.append((
                         rid, seq,
@@ -339,6 +375,73 @@ class CrashSoakRunner:
         else:
             self.report.set_barriers_empty += 1
 
+    # ---- sequence-lattice actions (Q-invariants) ----
+
+    def _seq_write(self) -> None:
+        r = self.report
+        d = self.rng.choice(self.daemons)
+        if not d.running:
+            return
+        rid = d.wire_rid
+        idx = self.rng.randint(0, 20)  # daemon clamps to its list length
+        if self.rng.random() < 0.65:
+            elem = f"q{len(self.seq_inserts)}"
+            code, body = _http(d.url + "/seq/insert", "POST",
+                               {"elem": elem, "index": idx})
+            if code == 200:
+                got = json.loads(body)
+                seq = self.seq_accepted_per_boot.get(rid, 0)
+                assert (got["rid"], got["seq"]) == (rid, seq), (
+                    f"Q1: daemon minted {got['rid']}:{got['seq']}, oracle "
+                    f"expected {rid}:{seq}"
+                )
+                self.seq_accepted_per_boot[rid] = seq + 1
+                self.seq_inserts.append((rid, seq, elem))
+                r.seq_inserts += 1
+        else:
+            code, body = _http(d.url + "/seq/remove", "POST", {"index": idx})
+            if code == 200:
+                got = json.loads(body)
+                if got["removed"]:
+                    seq = self.seq_accepted_per_boot.get(rid, 0)
+                    assert (got["rid"], got["seq"]) == (rid, seq), (
+                        f"Q1: daemon minted {got['rid']}:{got['seq']} for a "
+                        f"remove, oracle expected {rid}:{seq}"
+                    )
+                    self.seq_accepted_per_boot[rid] = seq + 1
+                    self.seq_removes.append((
+                        rid, seq, tuple(int(x) for x in got["target"])
+                    ))
+                    r.seq_removes += 1
+
+    def _seq_pull(self) -> None:
+        up = self._running()
+        if not up:
+            return
+        d = self.rng.choice(up)
+        peer = self.rng.choice(d.peer_urls)
+        code, body = _http(d.url + "/admin/seq_pull", "POST", {"peer": peer})
+        assert code == 200, f"Q3: seq pull 500d: {body!r}"
+        self.report.seq_pulls += json.loads(body)["pulled"]
+
+    def _seq_barrier(self) -> None:
+        d = self.daemons[0]  # the fleet's single coordinator
+        if not d.running:
+            return
+        code, body = _http(d.url + "/admin/seq_barrier", "POST", {})
+        assert code == 200, f"Q3: seq barrier 500d: {body!r}"
+        floor = {int(k): int(v) for k, v in json.loads(body)["floor"].items()}
+        if floor:
+            for k, v in self.last_seq_floor.items():
+                assert floor.get(k, -1) >= v, (
+                    f"Q2: barrier floor regressed at writer {k}: "
+                    f"{floor} < {self.last_seq_floor}"
+                )
+            self.last_seq_floor = floor
+            self.report.seq_barriers += 1
+        else:
+            self.report.seq_barriers_empty += 1
+
     def _pull(self) -> None:
         up = self._running()
         if not up:
@@ -373,6 +476,7 @@ class CrashSoakRunner:
         rid = d.wire_rid
         self.ckpt_watermark[rid] = self.accepted_per_boot.get(rid, 0)
         self.set_ckpt_watermark[rid] = self.set_accepted_per_boot.get(rid, 0)
+        self.seq_ckpt_watermark[rid] = self.seq_accepted_per_boot.get(rid, 0)
         self.report.checkpoints += 1
 
     def _soft_toggle(self) -> None:
@@ -404,23 +508,29 @@ class CrashSoakRunner:
 
     def step(self) -> None:
         x = self.rng.random()
-        if x < 0.25:
+        if x < 0.18:
             self._write()
-        elif x < 0.40:
+        elif x < 0.29:
             self._set_write()
-        elif x < 0.55:
+        elif x < 0.40:
+            self._seq_write()
+        elif x < 0.51:
             self._pull()
-        elif x < 0.63:
+        elif x < 0.57:
             self._set_pull()
-        elif x < 0.70:
+        elif x < 0.63:
+            self._seq_pull()
+        elif x < 0.685:
             self._barrier()
-        elif x < 0.77:
+        elif x < 0.74:
             self._set_barrier()
-        elif x < 0.85:
+        elif x < 0.795:
+            self._seq_barrier()
+        elif x < 0.855:
             self._checkpoint()
         elif x < 0.88:
             self._soft_toggle()
-        elif x < 0.93:
+        elif x < 0.925:
             self._sigkill()
         else:
             self._restore()
@@ -449,6 +559,7 @@ class CrashSoakRunner:
             # states can agree by luck while an undelivered delta-0 op is
             # still missing somewhere — vv equality closes that hole
             vvs, set_vvs, set_members = [], [], []
+            seq_vvs, seq_items = [], []
             for d in self.daemons:
                 code, body = _http(d.url + "/vv")
                 vvs.append(json.loads(body)["vv"] if code == 200 else None)
@@ -460,12 +571,22 @@ class CrashSoakRunner:
                 set_members.append(
                     json.loads(body)["members"] if code == 200 else None
                 )
+                code, body = _http(d.url + "/seq/vv")
+                seq_vvs.append(
+                    json.loads(body)["vv"] if code == 200 else None
+                )
+                code, body = _http(d.url + "/seq")
+                seq_items.append(
+                    json.loads(body)["items"] if code == 200 else None
+                )
             if (
                 all(s is not None for s in states)
                 and all(s == states[0] for s in states[1:])
                 and all(v == vvs[0] for v in vvs)
                 and all(v == set_vvs[0] for v in set_vvs)
                 and all(m == set_members[0] for m in set_members)
+                and all(v == seq_vvs[0] for v in seq_vvs)
+                and all(m == seq_items[0] for m in seq_items)
             ):
                 break
             assert rounds < max_rounds, f"liveness violated (I3): {states}"
@@ -477,6 +598,9 @@ class CrashSoakRunner:
                     code, body = _http(d.url + "/admin/set_pull", "POST",
                                        {"peer": peer})
                     assert code == 200, f"S3: heal set pull 500d: {body!r}"
+                    code, body = _http(d.url + "/admin/seq_pull", "POST",
+                                       {"peer": peer})
+                    assert code == 200, f"Q3: heal seq pull 500d: {body!r}"
             rounds += 1
         r.rounds_to_converge = rounds
 
@@ -577,6 +701,63 @@ class CrashSoakRunner:
             f"fleet={got_members} oracle={want_members}"
         )
         r.final_members = len(got_members)
+
+        # ---- sequence invariants (Q1/Q2) over the converged fleet ----
+        code, body = _http(self.daemons[0].url + "/seq/vv")
+        assert code == 200
+        got_seq = json.loads(body)
+        seq_vv = {int(k): int(v) for k, v in got_seq["vv"].items()}
+        seq_floor = {int(k): int(v) for k, v in got_seq["floor"].items()}
+
+        # Q2: heal-time floor dominates the last successful barrier
+        for k, v in self.last_seq_floor.items():
+            assert seq_floor.get(k, -1) >= v, (
+                f"Q2: floor rolled back at writer {k}: {seq_floor} < "
+                f"{self.last_seq_floor}"
+            )
+
+        # Q1a/Q1b: watermark rules
+        for rid, bar in self.seq_ckpt_watermark.items():
+            assert seq_vv.get(rid, -1) >= bar - 1, (
+                f"Q1a: checkpointed seq ops lost: writer {rid} had {bar}, "
+                f"fleet holds {seq_vv.get(rid, -1) + 1}"
+            )
+        for d in self.daemons:
+            rid = d.wire_rid
+            n = self.seq_accepted_per_boot.get(rid, 0)
+            assert seq_vv.get(rid, -1) == n - 1, (
+                f"Q1b: live seq writer {rid} accepted {n}, fleet holds "
+                f"{seq_vv.get(rid, -1) + 1}"
+            )
+
+        # Q1c: converged membership == targeted-remove fold of exactly
+        # the vv-surviving seq ops (order agreement is enforced by the
+        # convergence loop: every daemon rendered the identical list)
+        surviving_ins = [
+            (rid, seq, elem) for rid, seq, elem in self.seq_inserts
+            if seq <= seq_vv.get(rid, -1)
+        ]
+        dead_idents = set()
+        seq_survived = len(surviving_ins)
+        for rid, seq, target in self.seq_removes:
+            if seq <= seq_vv.get(rid, -1):
+                seq_survived += 1
+                dead_idents.add(target)
+        want_items = sorted(
+            elem for rid, seq, elem in surviving_ins
+            if (rid, seq) not in dead_idents
+        )
+        r.seq_ops_lost = (
+            len(self.seq_inserts) + len(self.seq_removes) - seq_survived
+        )
+        code, body = _http(self.daemons[0].url + "/seq")
+        assert code == 200
+        got_items = json.loads(body)["items"]
+        assert sorted(got_items) == want_items, (
+            f"Q1c: sequence content diverged from the surviving-op fold: "
+            f"fleet={sorted(got_items)} oracle={want_items}"
+        )
+        r.final_len = len(got_items)
         return r
 
     def close(self) -> None:

@@ -152,6 +152,8 @@ def stack(logs: oplog.OpLog, bits=DEFAULT_BITS) -> ColumnarOpLog:
             )
     if _field_min(ts) < 0:
         raise ValueError("negative ts cannot ride the columnar layout")
+    # (ts == SENTINEL cannot be caught here: the valid mask IS that
+    # encoding — the guard lives at mint/ingest time, api/node.py)
     if _field_min(payload) < 0:
         raise ValueError("negative payload id cannot carry the is_num bit")
 

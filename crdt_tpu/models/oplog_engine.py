@@ -67,6 +67,10 @@ def columnar_plan(state: oplog.OpLog):
     ts_min, _ = _field_range(state.ts, valid)
     if ts_min < 0:
         return None, f"negative ts {ts_min} cannot carry the SENTINEL sign bit"
+    # NOTE: a row AT ts == SENTINEL cannot be gated here — the valid mask
+    # above is that same encoding, so such a row is indistinguishable
+    # from padding in ANY engine.  The guard lives at mint/ingest time
+    # (api/node.py add_command + receive reject ts >= INT32_MAX).
     pay_min, _ = _field_range(state.payload, valid)
     if pay_min < 0:
         return None, f"negative payload id {pay_min} cannot carry the is_num bit"
