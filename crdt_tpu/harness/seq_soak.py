@@ -124,10 +124,16 @@ class SeqSoakRunner:
         p_revive: float = 0.06,
         p_restart: float = 0.06,
         p_barrier: float = 0.12,
+        engine: str = "auto",
     ):
         self.rng = random.Random(seed)
         self.n = n
         self.capacity = capacity
+        # "auto" = the columnar lexN engine whenever eligible (the
+        # production default — rseq_engine.gc_join_checked_auto /
+        # gc_round's adapter hook, loud EngineFallback otherwise);
+        # "generic" pins the row-major path (the A/B reference)
+        self.engine = engine
         self.states = [
             tomb_gc.wrap(rseq.empty(capacity), n) for _ in range(n)
         ]
@@ -261,7 +267,14 @@ class SeqSoakRunner:
         j = self.rng.randrange(self.n)
         if i == j or not (self.alive[i] and self.alive[j]):
             return
-        out, nu = tomb_gc.join_checked(self.states[i], self.states[j], AD)
+        if self.engine == "generic":
+            out, nu = tomb_gc.join_checked(self.states[i], self.states[j], AD)
+        else:
+            from crdt_tpu.models import rseq_engine
+
+            out, nu = rseq_engine.gc_join_checked_auto(
+                self.states[i], self.states[j]
+            )
         assert int(nu) <= self.capacity, "capacity overflow breaks GC (Q5)"
         self.states[i] = out
         self._sync_writer(i)
@@ -308,6 +321,7 @@ class SeqSoakRunner:
             # the neutral must track the fleet's CURRENT depth (widening
             # migrations change the key width)
             AD, rseq.empty(self.capacity, depth=self.states[0].inner.depth),
+            engine=self.engine,
         )
         self.states = [
             jax.tree.map(lambda x: x[i], sw.state) for i in range(self.n)
@@ -380,12 +394,16 @@ def main(argv=None) -> int:
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--capacity", type=int, default=512)
     ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu")
+    ap.add_argument("--engine", choices=["auto", "generic"], default="auto",
+                    help="auto = columnar lexN engine when eligible (the "
+                         "default); generic pins the row-major A/B path")
     args = ap.parse_args(argv)
     if args.platform != "ambient":
         jax.config.update("jax_platforms", "cpu")
     for seed in range(args.seeds):
         runner = SeqSoakRunner(
             n=args.replicas, seed=seed, capacity=args.capacity,
+            engine=args.engine,
         )
         print(f"seed {seed}: {runner.run(args.steps)}")
     return 0

@@ -316,6 +316,17 @@ class GC_ADAPTER:
             "removed": jnp.where(mask, False, s.removed),
         }
 
+    @staticmethod
+    def columnar_converge(sw, interpret=None):
+        """gc_round's engine hook: the barrier convergence phase on the
+        fused lexN kernel (crdt_tpu.models.rseq_engine), the DEFAULT for
+        RSeq swarms.  Returns (converged swarm, max_n_unique) or None
+        after a loud EngineFallback warning when the layout is
+        ineligible (tomb_gc.gc_round then runs the generic reduction)."""
+        from crdt_tpu.models import rseq_engine
+
+        return rseq_engine.gc_converge_swarm(sw, interpret=interpret)
+
 
 # ---- host-side identity allocation ------------------------------------------
 

@@ -228,12 +228,19 @@ class GcOverflow(RuntimeError):
     permanent, silent data loss.  The barrier refuses instead."""
 
 
-def gc_round(sw, adapter, neutral_inner):
+def gc_round(sw, adapter, neutral_inner, engine: str = "auto"):
     """One swarm-wide GC barrier over a Swarm of Gc states: converge the
     alive replicas (flag agreement), then agree on the stable floor
     (chain-ruled against every existing floor, dead replicas' included)
     and collect it everywhere alive.  Dead replicas keep their state and
     floor; one GC-aware join catches them up on revival.
+
+    The convergence phase rides the adapter's columnar fused-kernel
+    engine by DEFAULT when it declares one (``adapter.columnar_converge``
+    — rseq.GC_ADAPTER does; the hook warns EngineFallback and returns
+    None when the layout is ineligible, and the generic vmapped
+    reduction serves).  ``engine="generic"`` pins the generic path (the
+    A/B reference).
 
     The convergence runs through CHECKED joins and raises GcOverflow if
     any pairwise union truncated — the floor must never advance over
@@ -244,34 +251,47 @@ def gc_round(sw, adapter, neutral_inner):
 
     neutral = wrap(neutral_inner, sw.state.floor.shape[-1])
     jbc = jax.vmap(lambda x, y: join_checked(x, y, adapter))
+    cap = adapter.capacity_of(neutral_inner)
 
     with trace_region("tomb_gc.barrier"):
-        # converge (alive LUB + broadcast) with overflow tracking: the same
-        # log-depth tree reduction joins.tree_reduce_join runs, unrolled
-        # here so each level's n_unique is observable host-side
-        state = joins_mod.pad_to_pow2(
-            swarm_mod.mask_dead_with_neutral(sw.state, sw.alive, neutral),
-            neutral,
-        )
-        cap = adapter.capacity_of(neutral_inner)
-        max_nu = 0
-        p = jax.tree.leaves(state)[0].shape[0]
-        while p > 1:
-            p //= 2
-            lo = jax.tree.map(lambda x: x[:p], state)
-            hi = jax.tree.map(lambda x: x[p : 2 * p], state)
-            state, nu = jbc(lo, hi)
-            max_nu = max(max_nu, int(nu.max()))
-        if max_nu > cap:
-            raise GcOverflow(
-                f"GC barrier union needs {max_nu} rows but capacity is {cap}"
+        converged = None
+        hook = getattr(adapter, "columnar_converge", None)
+        if engine != "generic" and hook is not None:
+            res = hook(sw)
+            if res is not None:
+                converged, max_nu = res
+                if max_nu > cap:
+                    raise GcOverflow(
+                        f"GC barrier union needs {max_nu} rows but "
+                        f"capacity is {cap}"
+                    )
+        if converged is None:
+            # generic fallback: the same log-depth tree reduction
+            # joins.tree_reduce_join runs, unrolled here so each level's
+            # n_unique is observable host-side
+            state = joins_mod.pad_to_pow2(
+                swarm_mod.mask_dead_with_neutral(sw.state, sw.alive, neutral),
+                neutral,
             )
-        top = jax.tree.map(lambda x: x[0], state)
-        sw = sw.replace(
-            state=swarm_mod.broadcast_where_alive(sw.state, sw.alive, top)
-        )
+            max_nu = 0
+            p = jax.tree.leaves(state)[0].shape[0]
+            while p > 1:
+                p //= 2
+                lo = jax.tree.map(lambda x: x[:p], state)
+                hi = jax.tree.map(lambda x: x[p : 2 * p], state)
+                state, nu = jbc(lo, hi)
+                max_nu = max(max_nu, int(nu.max()))
+            if max_nu > cap:
+                raise GcOverflow(
+                    f"GC barrier union needs {max_nu} rows but capacity "
+                    f"is {cap}"
+                )
+            top = jax.tree.map(lambda x: x[0], state)
+            converged = sw.replace(
+                state=swarm_mod.broadcast_where_alive(sw.state, sw.alive, top)
+            )
         return swarm_mod.compaction_round(
-            sw,
+            converged,
             received_vv=lambda st: received_vv(st, adapter),
             compact=lambda st, f: collect(st, f, adapter),
             frontier_of=lambda st: st.floor,

@@ -177,8 +177,15 @@ def _hole_compact(key_planes, val_planes, n):
         cand_k = [_shift_up(k, s, SENTINEL) for k in key_planes]
         cand_v = [_shift_up(v, s, 0) for v in val_planes]
         cand_d = _shift_up(disp, s, 0)
-        take = (cand_k[0] != SENTINEL) & ((cand_d & s) != 0)
-        keep = (key_planes[0] != SENTINEL) & ((disp & s) == 0)
+        # no hole guards needed on either mask (round-4 op-count cut, ~25%
+        # of this stage's ALU work): holes carry disp = 0 from the init
+        # above and from the not-take-not-keep else-branches below, so a
+        # hole is never TAKEN (its cand_d bit is 0), and a "kept" hole
+        # just rewrites SENTINEL/0 onto itself — same fixpoint, two fewer
+        # compares and an AND per plane-row per step (validated by the
+        # host oracle in tests/test_pallas_union.py and hw_selftest)
+        take = (cand_d & s) != 0
+        keep = (disp & s) == 0
         key_planes = [
             jnp.where(take, ck, jnp.where(keep, k, SENTINEL))
             for ck, k in zip(cand_k, key_planes)
