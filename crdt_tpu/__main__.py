@@ -49,6 +49,7 @@ def run_demo(args) -> int:
         reference_topology=args.reference_topology,
         compact_every=args.compact_every,
         delta_gossip=not args.full_gossip,
+        set_collect_every=args.set_collect_every if args.with_sets else 0,
     )
     cluster = LocalCluster(cfg)
     http = HttpCluster(cluster)
@@ -63,21 +64,33 @@ def run_demo(args) -> int:
     t_end = time.time() + args.duration if args.duration else None
     writes = 0
     last_report = time.time()
+    set_ops = 0
     try:
         while t_end is None or time.time() < t_end:
             writes += wg.drive_http(urls, 1)
+            if args.with_sets:
+                set_ops += wg.drive_set_http(urls, 1)
             if time.time() - last_report >= args.report_every:
                 converged = cluster.converged()
                 alive = [s for s in cluster.states() if s is not None]
                 keys = len(alive[0]) if alive else 0
                 m = cluster.metrics.snapshot()
-                print(
+                line = (
                     f"[{time.strftime('%H:%M:%S')}] writes={writes} "
                     f"keys={keys} converged={converged} "
                     f"gossip_rounds={m.get('gossip_rounds', 0)} "
                     f"payload_ops={m.get('gossip_payload_ops', 0)} "
                     f"merge_p50_ms={m.get('merge_p50_ms', 'n/a')}"
                 )
+                if args.with_sets:
+                    members = cluster.set_nodes[0].members() or []
+                    line += (
+                        f" | set_ops={set_ops} members={len(members)} "
+                        f"set_converged={cluster.set_converged()} "
+                        f"set_collections="
+                        f"{m.get('set_collections', 0)}"
+                    )
+                print(line)
                 last_report = time.time()
             time.sleep(cfg.write_period_ms / 1000.0)
     except KeyboardInterrupt:
@@ -89,17 +102,24 @@ def run_demo(args) -> int:
     # final report: drive to the fixpoint (bounded: random-peer pulls can
     # miss — especially under --reference-topology's dead-port friend list)
     ok = cluster.converged()
+    set_ok = cluster.set_converged() if args.with_sets else True
     for _ in range(64 * len(cluster.nodes)):
-        if ok:
+        if ok and set_ok:
             break
         cluster.tick()
         ok = cluster.converged()
+        set_ok = cluster.set_converged() if args.with_sets else True
     alive = [s for s in cluster.states() if s is not None]
-    print(f"final: writes={writes} converged={ok} "
-          f"state_keys={len(alive[0]) if alive else 0}")
+    line = (f"final: writes={writes} converged={ok} "
+            f"state_keys={len(alive[0]) if alive else 0}")
+    if args.with_sets:
+        members = cluster.set_nodes[0].members() or []
+        line += (f" | set_ops={set_ops} set_converged={set_ok} "
+                 f"members={len(members)}")
+    print(line)
     if args.dump_state and alive:
         print(json.dumps(alive[0], sort_keys=True))
-    return 0 if ok else 1
+    return 0 if ok and set_ok else 1
 
 
 def run_daemon(args) -> int:
@@ -159,6 +179,15 @@ def run_daemon(args) -> int:
         checkpoint_every_s=args.checkpoint_every_s,
     )
     host.start()
+    # pre-compile the sequence lattice's device paths in the background:
+    # a daemon's first /seq ingest otherwise pays multi-second jit
+    # compiles inside a peer's request deadline.  Backgrounded so a
+    # KV-only fleet's boot (and its /ping health gate) never waits on
+    # compiles it may not need; an early /seq request simply races the
+    # same cache fill (harmless duplicate work).
+    import threading as _threading
+
+    _threading.Thread(target=host.seq_node.warmup, daemon=True).start()
     print(f"replica rid={rid} (base {args.rid}, incarnation {incarnation}, "
           f"restored={host.restored}) serving on {host.url}, "
           f"{len(peers)} peer(s)", flush=True)
@@ -205,9 +234,14 @@ def main(argv=None) -> int:
                     help="ship the full log every round (reference behavior) "
                          "instead of deltas")
     ap.add_argument("--set-collect-every", type=int, default=0,
-                    help="daemon: run a set-lattice GC barrier every N "
-                         "gossip rounds (coordinator only; 0 = only "
-                         "explicit POST /admin/set_barrier)")
+                    help="run a set-lattice GC barrier every N gossip "
+                         "rounds (demo: scheduled by replica 0's loop, "
+                         "needs --with-sets; daemon: coordinator only; "
+                         "0 = only explicit POST /admin/set_barrier)")
+    ap.add_argument("--with-sets", action="store_true",
+                    help="demo: drive the OR-Set lattice alongside the KV "
+                         "workload (/set/add + /set/remove on random "
+                         "replicas) and report set convergence")
     ap.add_argument("--go-compat-gossip", action="store_true",
                     help="daemon: emit full-dump gossip with bare integer-ms "
                          "keys so an ORIGINAL Go peer can pull from this "

@@ -30,6 +30,15 @@ Set-lattice surface (crdt_tpu.api.setnode; present only with ``admin``):
   POST /set/remove              {"elem": str} -> observed-remove
   POST /set/collect             {"floor": {rid: seq}} -> GC fold
 
+Sequence-lattice surface (crdt_tpu.api.seqnode; present only with
+``admin``) — plus POST /admin/seq_pull and /admin/seq_barrier:
+  GET  /seq                     {"items": [...]} (live list, in order)
+  GET  /seq/gossip[?vv=...]     floor-carrying (delta) sequence payload
+  GET  /seq/vv                  {"vv": {rid: seq}, "floor": {rid: seq}}
+  POST /seq/insert              {"elem": str, "index": int|null} -> mint
+  POST /seq/remove              {"index": int} -> targeted remove
+  POST /seq/collect             {"floor": {rid: seq}} -> GC fold
+
 The /condition route takes the flag as a path segment (also accepted:
 ?alive_status=) — the reference registered the route without the parameter
 binding so every call 500'd (quirk §0.1.7); this shim implements what that
@@ -68,7 +77,15 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
 
         @property
         def set_node(self):
-            return getattr(admin, "set_node", None)
+            if admin is not None:
+                return getattr(admin, "set_node", None)
+            # demo mode: LocalCluster carries set siblings per replica
+            nodes = getattr(cluster, "set_nodes", None)
+            return nodes[idx] if nodes else None
+
+        @property
+        def seq_node(self):
+            return getattr(admin, "seq_node", None)
 
         def _parse_vv_query(self, url):
             """?vv=<json {rid: seq}> -> dict, None (absent), or the string
@@ -112,6 +129,38 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         self._send(502, "Unreachable")
                         return
                     vv, floor = sn.vv_snapshot()
+                    self._send(200, json.dumps({
+                        "vv": {str(r): s for r, s in vv.items()},
+                        "floor": {str(r): s for r, s in floor.items()},
+                    }), "application/json")
+                else:
+                    self._send(404, "not found")
+                return
+            if parts and parts[0] == "seq" and self.seq_node is not None:
+                qn = self.seq_node
+                if url.path == "/seq":
+                    items = qn.items()
+                    if items is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps({"items": items}),
+                                   "application/json")
+                elif url.path == "/seq/gossip":
+                    since = self._parse_vv_query(url)
+                    if since == "bad":
+                        self._send(400, "invalid vv")
+                        return
+                    payload = qn.gossip_payload(since=since)
+                    if payload is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps(payload),
+                                   "application/json")
+                elif url.path == "/seq/vv":
+                    if not qn.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    vv, floor = qn.vv_snapshot()
                     self._send(200, json.dumps({
                         "vv": {str(r): s for r, s in vv.items()},
                         "floor": {str(r): s for r, s in floor.items()},
@@ -222,6 +271,20 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                             }),
                             "application/json",
                         )
+                    elif path == "/admin/seq_pull":
+                        ok = admin.admin_seq_pull(body.get("peer"))
+                        self._send(200, json.dumps({"pulled": bool(ok)}),
+                                   "application/json")
+                    elif path == "/admin/seq_barrier":
+                        floor = admin.admin_seq_barrier()
+                        self._send(
+                            200,
+                            json.dumps({
+                                "floor": {str(r): s
+                                          for r, s in floor.items()}
+                            }),
+                            "application/json",
+                        )
                     else:
                         self._send(404, "not found")
                 except Exception as e:  # surfaced to the driving test: a
@@ -271,6 +334,63 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         self._send(400, "invalid floor")
                         return
                     sn.collect(floor)
+                    self._send(200, "OK")
+                else:
+                    self._send(404, "not found")
+                return
+            if path.startswith("/seq/") and self.seq_node is not None:
+                qn = self.seq_node
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    assert isinstance(body, dict)
+                except Exception:
+                    self._send(400, "invalid body")
+                    return
+                if path == "/seq/insert":
+                    idx = body.get("index")
+                    try:
+                        idx = None if idx is None else int(idx)
+                    except (TypeError, ValueError):
+                        self._send(400, "invalid index")
+                        return
+                    ident = qn.insert_at(idx, str(body.get("elem", "")))
+                    if ident is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps(
+                            {"rid": ident[0], "seq": ident[1]}
+                        ), "application/json")
+                elif path == "/seq/remove":
+                    if not qn.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    try:
+                        idx = int(body.get("index"))
+                    except (TypeError, ValueError):
+                        self._send(400, "invalid index")
+                        return
+                    ident = qn.remove_at(idx)
+                    op = qn.op_record(ident) if ident else None
+                    self._send(200, json.dumps({
+                        "removed": ident is not None,
+                        "rid": ident[0] if ident else None,
+                        "seq": ident[1] if ident else None,
+                        "target": (op or {}).get("del"),
+                    }), "application/json")
+                elif path == "/seq/collect":
+                    if not qn.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    try:
+                        floor = {
+                            int(r): int(s)
+                            for r, s in (body.get("floor") or {}).items()
+                        }
+                    except Exception:
+                        self._send(400, "invalid floor")
+                        return
+                    qn.collect(floor)
                     self._send(200, "OK")
                 else:
                     self._send(404, "not found")

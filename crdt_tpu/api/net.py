@@ -43,6 +43,12 @@ class RemotePeer:
     def __init__(self, url: str, timeout: float = 5.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        # None = unknown, False = peer 404'd /set/gossip (an original
+        # reference peer — main.go serves no /set surface), True = seen
+        # serving it.  Lets mixed fleets stop re-probing Go peers every
+        # round and keeps the outage metrics truthful.
+        self.serves_set: Optional[bool] = None
+        self.serves_seq: Optional[bool] = None  # same, for /seq/gossip
 
     def _get(self, path: str) -> Optional[bytes]:
         try:
@@ -131,17 +137,48 @@ class RemotePeer:
             {"frontier": {str(r): s for r, s in frontier.items()}},
         )
 
+    # ---- extension-surface probe (shared by /set and /seq clients) ----
+
+    def _probe_get(self, path: str, flag_attr: str):
+        """_get plus surface detection: a 404 permanently marks the peer
+        as lacking this surface (an original Go peer — main.go serves
+        neither /set nor /seq), a parsed 200 marks it as serving.  The
+        flag lets mixed fleets stop re-probing Go peers every round and
+        keeps the outage metrics truthful."""
+        if getattr(self, flag_attr) is False:
+            return None
+        try:
+            with urllib.request.urlopen(
+                self.url + path, timeout=self.timeout
+            ) as res:
+                body = res.read() if res.status == 200 else None
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                setattr(self, flag_attr, False)
+            return None
+        except (urllib.error.URLError, OSError):
+            return None
+        out = self._parse(body)
+        if out is not None:
+            setattr(self, flag_attr, True)
+        return out
+
+    @staticmethod
+    def _vv_query(path: str, since: Optional[Dict[int, int]]) -> str:
+        if since is None:
+            return path
+        vv = json.dumps({str(r): s for r, s in since.items()})
+        return path + "?vv=" + urllib.parse.quote(vv)
+
     # ---- set-lattice surface (crdt_tpu.api.setnode) ----
 
     def set_gossip_payload(
         self, since: Optional[Dict[int, int]] = None
     ) -> Optional[Dict[str, Any]]:
         """GET /set/gossip (floor-carrying delta; full fallback)."""
-        path = "/set/gossip"
-        if since is not None:
-            vv = json.dumps({str(r): s for r, s in since.items()})
-            path += "?vv=" + urllib.parse.quote(vv)
-        return self._parse(self._get(path))
+        return self._probe_get(
+            self._vv_query("/set/gossip", since), "serves_set"
+        )
 
     def set_vv(self):
         """GET /set/vv → (vv, floor) or None when down/unreachable."""
@@ -157,6 +194,33 @@ class RemotePeer:
         """POST /set/collect: advance the GC floor (barrier fold)."""
         return self._post(
             "/set/collect",
+            {"floor": {str(r): s for r, s in floor.items()}},
+        )
+
+    # ---- sequence-lattice surface (crdt_tpu.api.seqnode) ----
+
+    def seq_gossip_payload(
+        self, since: Optional[Dict[int, int]] = None
+    ) -> Optional[Dict[str, Any]]:
+        """GET /seq/gossip (floor-carrying delta; full fallback)."""
+        return self._probe_get(
+            self._vv_query("/seq/gossip", since), "serves_seq"
+        )
+
+    def seq_vv(self):
+        """GET /seq/vv → (vv, floor) or None when down/unreachable."""
+        d = self._parse(self._get("/seq/vv"))
+        if d is None:
+            return None
+        return (
+            {int(r): int(s) for r, s in (d.get("vv") or {}).items()},
+            {int(r): int(s) for r, s in (d.get("floor") or {}).items()},
+        )
+
+    def seq_collect(self, floor: Dict[int, int]) -> bool:
+        """POST /seq/collect: advance the GC floor (barrier fold)."""
+        return self._post(
+            "/seq/collect",
             {"floor": {str(r): s for r, s in floor.items()}},
         )
 
@@ -218,9 +282,11 @@ class NetworkAgent:
         seed: Optional[int] = None,
         coordinator: bool = False,
         set_node=None,
+        seq_node=None,
     ):
         self.node = node
         self.set_node = set_node  # optional SetNode sibling: pulled together
+        self.seq_node = seq_node  # optional SeqNode sibling: pulled together
         self.peers = [RemotePeer(u) for u in peer_urls]
         self.config = config or ClusterConfig()
         self.metrics = metrics or node.metrics
@@ -233,6 +299,13 @@ class NetworkAgent:
         self.errors: List[Exception] = []
 
     def gossip_once(self) -> bool:
+        """One pull round from a random peer: KV log + (when both ends
+        serve them) the set and sequence lattices.  Returns whether the
+        KV pull merged anything — the extension surfaces report
+        separately through their *_gossip_* metrics and their own pull
+        returns, so the surfaces' freshness is never conflated
+        (/admin/pull's {"pulled"} and the soak's pulls counter are KV
+        facts)."""
         if not self.peers:
             self.metrics.inc("net_gossip_skipped")
             return False
@@ -244,21 +317,46 @@ class NetworkAgent:
             delta=self.config.delta_gossip,
             prefix="net_gossip",
         )
-        return self.set_pull(peer) or merged
+        self.set_pull(peer)
+        self.seq_pull(peer)
+        return merged
 
     def set_pull(self, peer: RemotePeer) -> bool:
         """One set-lattice pull from ``peer`` (no-op without a set node).
         Always delta-requested: the sender itself decides when a full
-        payload is needed (the floor-validity rule, setnode.gossip_payload)."""
+        payload is needed (the floor-validity rule, setnode.gossip_payload).
+        Peers known to lack the /set surface (original Go peers, 404) are
+        counted under set_gossip_unsupported, not as outages."""
         sn = self.set_node
         if sn is None or not sn.alive:
             return False
         payload = peer.set_gossip_payload(since=sn.version_vector())
         if payload is None:
-            self.metrics.inc("set_gossip_skipped")
+            self.metrics.inc(
+                "set_gossip_unsupported" if peer.serves_set is False
+                else "set_gossip_skipped"
+            )
             return False
         fresh = sn.receive(payload)
         self.metrics.inc("set_gossip_rounds" if fresh else "set_gossip_noop")
+        return fresh > 0
+
+    def seq_pull(self, peer: RemotePeer) -> bool:
+        """One sequence-lattice pull from ``peer`` (no-op without a seq
+        node) — the seq sibling of set_pull, same delta-request and
+        404-skip rules."""
+        qn = self.seq_node
+        if qn is None or not qn.alive:
+            return False
+        payload = peer.seq_gossip_payload(since=qn.version_vector())
+        if payload is None:
+            self.metrics.inc(
+                "seq_gossip_unsupported" if peer.serves_seq is False
+                else "seq_gossip_skipped"
+            )
+            return False
+        fresh = qn.receive(payload)
+        self.metrics.inc("seq_gossip_rounds" if fresh else "seq_gossip_noop")
         return fresh > 0
 
     def start(self) -> None:
@@ -314,6 +412,28 @@ class NetworkAgent:
         self.metrics.inc("set_collections_scheduled")
         return floor
 
+    def seq_collect_once(self) -> dict:
+        """One swarm-wide sequence GC barrier (coordinator only): agree on
+        the stable floor over every member's /seq/vv and tell everyone to
+        collect it — the seq sibling of set_collect_once, same
+        skip-on-unreachable rule."""
+        from crdt_tpu.api import seqnode as seqnode_mod
+
+        qn = self.seq_node
+        if qn is None or not qn.alive:
+            self.metrics.inc("seq_collect_skipped")
+            return {}
+        with ThreadPoolExecutor(max_workers=max(len(self.peers), 1)) as pool:
+            got = list(pool.map(lambda p: p.seq_vv(), self.peers))
+            floor = seqnode_mod.seq_barrier(qn, got)
+            if not floor:
+                self.metrics.inc("seq_collect_skipped")
+                return {}
+            qn.collect(floor)
+            list(pool.map(lambda p: p.seq_collect(floor), self.peers))
+        self.metrics.inc("seq_collections_scheduled")
+        return floor
+
     def _loop(self) -> None:
         period = self.config.gossip_period_ms / 1000.0
         rounds = 0
@@ -330,6 +450,9 @@ class NetworkAgent:
                 sce = self.config.set_collect_every
                 if self.coordinator and sce and rounds % sce == 0:
                     self.set_collect_once()
+                qce = self.config.seq_collect_every
+                if self.coordinator and qce and rounds % qce == 0:
+                    self.seq_collect_once()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.metrics.inc("net_gossip_loop_errors")
                 self.errors.append(e)
@@ -363,6 +486,7 @@ class NodeHost:
         checkpoint_every_s: float = 0,
     ):
         from crdt_tpu.api.http_shim import _make_handler
+        from crdt_tpu.api.seqnode import SeqNode
         from crdt_tpu.api.setnode import SetNode
 
         self.config = config or ClusterConfig()
@@ -385,6 +509,9 @@ class NodeHost:
         # set vv/floor never mix with the KV vv/frontier), gossiped and
         # checkpointed alongside the KV node
         self.set_node = SetNode(rid=rid)
+        # the sequence-lattice sibling (crdt_tpu.api.seqnode): same wire
+        # rid, disjoint namespace, gossiped and checkpointed alongside
+        self.seq_node = SeqNode(rid=rid)
         # crash recovery: restore the newest complete snapshot (if any)
         # BEFORE serving.  The caller is responsible for minting rid via
         # checkpoint.bump_incarnation when restores can land in a live
@@ -398,12 +525,13 @@ class NodeHost:
             # (restore boots alive — the checkpoint layer treats the alive
             # flag as fault-injection state, not durable data)
             self.restored = ckpt.load_latest_node(
-                checkpoint_dir, self.node, set_node=self.set_node
+                checkpoint_dir, self.node, set_node=self.set_node,
+                seq_node=self.seq_node,
             )
         self.nodes = [self.node]  # duck-types as a cluster for the handler
         self.agent = NetworkAgent(
             self.node, peers, self.config, coordinator=coordinator,
-            set_node=self.set_node,
+            set_node=self.set_node, seq_node=self.seq_node,
         )
         self._server = ThreadingHTTPServer(
             (host, port), _make_handler(self, 0, admin=self)
@@ -474,7 +602,8 @@ class NodeHost:
         from crdt_tpu.utils import checkpoint as ckpt
 
         return ckpt.save_node_atomic(
-            self.checkpoint_dir, self.node, set_node=self.set_node
+            self.checkpoint_dir, self.node, set_node=self.set_node,
+            seq_node=self.seq_node,
         )
 
     def admin_pull(self, peer_url: Optional[str] = None) -> bool:
@@ -511,3 +640,18 @@ class NodeHost:
     def admin_set_barrier(self) -> dict:
         """One set GC barrier, now (coordinator only)."""
         return self.agent.set_collect_once()
+
+    def admin_seq_pull(self, peer_url: Optional[str] = None) -> bool:
+        """One sequence-lattice pull, now, from ``peer_url`` (or a random
+        configured peer)."""
+        if peer_url is None:
+            if not self.agent.peers:
+                return False
+            peer = self.agent._rng.choice(self.agent.peers)
+        else:
+            peer = RemotePeer(peer_url)
+        return self.agent.seq_pull(peer)
+
+    def admin_seq_barrier(self) -> dict:
+        """One sequence GC barrier, now (coordinator only)."""
+        return self.agent.seq_collect_once()

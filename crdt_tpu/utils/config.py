@@ -56,6 +56,9 @@ class ClusterConfig:
     # go_compat_gossip mixed fleets — the /set routes are not part of the
     # Go-visible surface).
     set_collect_every: int = 0
+    # same, for the sequence lattice (crdt_tpu.api.seqnode): a seq GC
+    # barrier every N gossip rounds (0 = only explicit /admin/seq_barrier)
+    seq_collect_every: int = 0
     # emit full-dump gossip with the reference's bare integer-ms keys so an
     # ORIGINAL Go peer can pull from this fleet without killing its gossip
     # loop (quirk §0.1.8).  Lossy by the reference's own rule: same-ms ops

@@ -1,0 +1,229 @@
+"""SeqNode: the sequence lattice across the process boundary (VERDICT
+round 3, item 4) — op-identified inserts/removes with path-key wire
+encoding, per-writer vv deltas, floor-carrying GC, crash-safe snapshot
+sections, and the /seq/* HTTP surface."""
+import json
+import urllib.request
+
+import pytest
+
+from crdt_tpu.api.seqnode import FLOOR_KEY, FULL_KEY, SeqNode, seq_barrier
+
+
+def pull(dst: SeqNode, src: SeqNode, delta: bool = True) -> int:
+    """One pull round dst <- src (the NetworkAgent.seq_pull shape)."""
+    since = dst.version_vector() if delta else None
+    return dst.receive(src.gossip_payload(since=since))
+
+
+def sync(a: SeqNode, b: SeqNode) -> None:
+    for _ in range(2):
+        pull(a, b)
+        pull(b, a)
+
+
+def test_basic_editing_and_order():
+    n = SeqNode(rid=0)
+    assert n.append("a") == (0, 0)
+    assert n.append("c") == (0, 1)
+    assert n.insert_at(1, "b") == (0, 2)
+    assert n.items() == ["a", "b", "c"]
+    assert n.remove_at(1) == (0, 3)
+    assert n.items() == ["a", "c"]
+    # out-of-range remove mints nothing
+    assert n.remove_at(5) is None
+    assert n.version_vector() == {0: 3}
+
+
+def test_two_writers_converge():
+    a, b = SeqNode(rid=0), SeqNode(rid=1)
+    for x in "one two three".split():
+        a.append(x)
+    sync(a, b)
+    assert b.items() == ["one", "two", "three"]
+    # concurrent edits: a types into the front, b into the back
+    a.insert_at(0, "zero")
+    b.append("four")
+    b.remove_at(1)  # "two"
+    sync(a, b)
+    assert a.items() == b.items() == ["zero", "one", "three", "four"]
+    assert a.idents() == b.idents()
+
+
+def test_delta_payload_is_tail_only():
+    a, b = SeqNode(rid=0), SeqNode(rid=1)
+    for i in range(5):
+        a.append(f"e{i}")
+    sync(a, b)
+    a.append("new")
+    payload = a.gossip_payload(since=b.version_vector())
+    ops = {k: v for k, v in payload.items() if k not in (FLOOR_KEY, FULL_KEY)}
+    assert list(ops) == ["0:5"]  # only the unseen op travels
+    assert b.receive(payload) == 1
+    assert b.items()[-1] == "new"
+
+
+def test_gc_barrier_prunes_and_delta_still_flows():
+    a, b = SeqNode(rid=0), SeqNode(rid=1)
+    for i in range(6):
+        a.append(f"e{i}")
+    sync(a, b)
+    b.remove_at(0)
+    b.remove_at(0)
+    sync(a, b)
+    floor = seq_barrier(a, [b.vv_snapshot()])
+    assert floor  # all members reachable
+    a.collect(floor)
+    b.collect(floor)
+    # collected: the two removed rows are gone from device AND host records
+    assert a.items() == b.items() == [f"e{i}" for i in range(2, 6)]
+    assert all("del" not in op for op in a._ops.values())
+    assert len(a._ops) == 4  # the four live inserts
+    # post-GC delta gossip still works (receiver dominates the floor)
+    a.append("tail")
+    assert pull(b, a) == 1
+    assert b.items()[-1] == "tail"
+
+
+def test_full_payload_suppresses_stale_live_copy():
+    """The resurrection case: c missed the removal, then the collection.
+    A full payload + floor adoption must kill c's stale live copy."""
+    a, b, c = SeqNode(rid=0), SeqNode(rid=1), SeqNode(rid=2)
+    for x in "abc":
+        a.append(x)
+    sync(a, b)
+    sync(a, c)  # c holds all three, live
+    b.remove_at(1)  # "b" removed...
+    sync(a, b)
+    floor = seq_barrier(a, [b.vv_snapshot()])
+    a.collect(floor)
+    b.collect(floor)  # ...and collected, while c was partitioned away
+    assert a.items() == ["a", "c"]
+    # c's vv does not dominate a's floor -> full payload + suppression
+    payload = a.gossip_payload(since=c.version_vector())
+    assert payload.get(FULL_KEY)
+    c.receive(payload)
+    assert c.items() == ["a", "c"]
+    # and the swarm stays converged afterwards
+    sync(a, c)
+    assert c.items() == ["a", "c"]
+
+
+def test_snapshot_roundtrip_and_seq_resume():
+    a = SeqNode(rid=0)
+    for x in "xyz":
+        a.append(x)
+    a.remove_at(0)
+    snap = json.loads(json.dumps(a.to_snapshot()))  # wire-shaped
+    b = SeqNode(rid=0)
+    b.from_snapshot(snap)
+    assert b.items() == a.items()
+    assert b.version_vector() == a.version_vector()
+    # the restored counter must not re-mint used identities
+    ident = b.append("w")
+    assert ident == (0, 4)
+
+
+def test_snapshot_after_collect_restores_floor():
+    a, b = SeqNode(rid=0), SeqNode(rid=1)
+    for x in "pqr":
+        a.append(x)
+    sync(a, b)
+    a.remove_at(2)
+    sync(a, b)
+    floor = seq_barrier(a, [b.vv_snapshot()])
+    a.collect(floor)
+    snap = a.to_snapshot()
+    fresh = SeqNode(rid=0)
+    fresh.from_snapshot(snap)
+    assert fresh.items() == ["p", "q"]
+    assert fresh._floor == a._floor
+    # a restored node can still serve deltas to a floor-dominating peer
+    b.collect(floor)
+    assert pull(b, fresh) == 0  # nothing new, but no full fallback crash
+
+
+def test_receive_widens_to_deep_wire_paths():
+    """Daemons with different local depths interoperate: the wire carries
+    real levels only, and a receiver widens its table on demand."""
+    from crdt_tpu.models import rseq
+
+    n = SeqNode(rid=1, depth=2)
+    mid_hi, mid_lo = rseq.split_pos(rseq.MID)
+    # a 3-level path (deeper than the table) minted by writer 0
+    op = {
+        "ins": "deep",
+        "path": [[1, 0, 0, 0], [2, 0, 0, 1], [3, 0, 0, 2]],
+    }
+    assert n.receive({"0:2": op}) == 1
+    assert n._depth >= 3
+    assert n.items() == ["deep"]
+    # and its own shallow edits still join fine afterwards
+    n.append("after")
+    assert n.items() == ["deep", "after"]
+
+
+def test_collect_is_all_or_nothing():
+    """A node behind the barrier floor adopts nothing (the setnode
+    incomparable-floor fix, mirrored here from day one)."""
+    a = SeqNode(rid=0)
+    a.append("only")
+    a.collect({0: 0, 5: 7})  # floor claims knowledge a doesn't have
+    assert a._floor == {}
+    assert a.metrics._counts["seq_collect_behind"] == 1
+
+
+@pytest.fixture()
+def hosts():
+    from crdt_tpu.api.net import NodeHost
+    from crdt_tpu.utils.config import ClusterConfig
+
+    cfg = ClusterConfig(delta_gossip=True)
+    a = NodeHost(rid=0, peers=[], config=cfg, coordinator=True)
+    b = NodeHost(rid=1, peers=[], config=cfg)
+    a.agent.peers = [_peer(b)]
+    b.agent.peers = [_peer(a)]
+    a.start_server()
+    b.start_server()
+    try:
+        yield a, b
+    finally:
+        a.stop_server()
+        b.stop_server()
+
+
+def _peer(host):
+    from crdt_tpu.api.net import RemotePeer
+
+    return RemotePeer(f"http://127.0.0.1:{host.port}")
+
+
+def _http(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=5) as res:
+        return res.status, res.read().decode()
+
+
+def test_http_surface(hosts):
+    a, b = hosts
+    code, out = _http(a.url + "/seq/insert", "POST",
+                      {"elem": "hello", "index": None})
+    assert code == 200 and json.loads(out) == {"rid": 0, "seq": 0}
+    _http(a.url + "/seq/insert", "POST", {"elem": "world"})
+    code, out = _http(b.url + "/admin/seq_pull", "POST", {"peer": a.url})
+    assert code == 200 and json.loads(out)["pulled"]
+    code, out = _http(b.url + "/seq")
+    assert json.loads(out)["items"] == ["hello", "world"]
+    # targeted remove over the wire, then a barrier from the coordinator
+    code, out = _http(b.url + "/seq/remove", "POST", {"index": 0})
+    got = json.loads(out)
+    assert got["removed"] and got["target"] == [0, 0]
+    _http(a.url + "/admin/seq_pull", "POST", {"peer": b.url})
+    code, out = _http(a.url + "/admin/seq_barrier", "POST", {})
+    assert code == 200 and json.loads(out)["floor"]
+    code, out = _http(a.url + "/seq")
+    assert json.loads(out)["items"] == ["world"]
+    # vv surface
+    code, out = _http(a.url + "/seq/vv")
+    assert code == 200 and "vv" in json.loads(out)
