@@ -17,6 +17,22 @@ dict per replica, joined with tombstone-OR):
   S4  safety      — no step raises: barriers with dead members degrade to
       no-ops via the floor chain rule, never corrupt.
 
+Round 4 adds the MAP workload (MapSoakRunner): the OR-Map's epoch-reset
+GC (crdt_tpu.models.ormap_gc) under updates/removes/joins/kills plus
+STALE-SNAPSHOT RESTORES (the schedule the per-key epochs exist for),
+checked after every action against a spec mirror implementing the
+reset-on-stable-remove semantics in plain python:
+
+  M1  transparency — device (contains, per-present-key values) equals the
+      mirror's after every action;
+  M2  reset safety — no resurrection and no unaccounted loss across
+      snapshot → barrier → stale-restore → rejoin schedules (implied by
+      M1: the mirror models exactly what a reset may discard);
+  M3  reclamation  — barriers reset stably-removed keys (reported,
+      asserted by CI for barrier-running schedules);
+  M4  safety      — no step raises; barriers with dead members are no-ops
+      (the full-fleet rule), never corrupt.
+
 CLI for long soaks:  python -m crdt_tpu.harness.gc_soak --steps 2000
 CI runs a short sweep (tests/test_gc_soak.py).
 """
@@ -283,6 +299,316 @@ class SetSoakRunner:
         return self.heal_and_check()
 
 
+@dataclasses.dataclass
+class MapSoakReport:
+    steps: int = 0
+    updates: int = 0
+    removes: int = 0
+    joins: int = 0
+    kills: int = 0
+    revivals: int = 0
+    snapshots: int = 0
+    restores: int = 0
+    barriers: int = 0
+    barriers_noop: int = 0
+    barriers_skipped: int = 0  # dead member -> full-fleet rule skipped it
+    keys_reset: int = 0
+    final_present: int = 0
+
+    def __str__(self) -> str:
+        return (
+            f"map-soak: {self.steps} steps, {self.updates} updates / "
+            f"{self.removes} removes, {self.joins} joins, {self.kills} "
+            f"kills / {self.revivals} revivals, {self.snapshots} snaps / "
+            f"{self.restores} stale restores, {self.barriers} barriers "
+            f"({self.barriers_noop} no-op, {self.barriers_skipped} "
+            f"skipped), {self.keys_reset} keys reset, "
+            f"final present {self.final_present}"
+        )
+
+
+class _MapMirror:
+    """Spec oracle for the GC'd OR-Map: token/seen vectors per key (the
+    observed-remove rule in plain python) + per-writer P/N cells + the
+    per-key RESET EPOCH, with the reset-wins join rule (ormap_gc module
+    docstring) written out the obvious scalar way — the device's
+    vectorized select/reset/converge is checked against this after every
+    action."""
+
+    def __init__(self, k: int, w: int):
+        self.k, self.w = k, w
+        self.tok = [[-1] * w for _ in range(k)]
+        self.seen = [[-1] * w for _ in range(k)]
+        self.p = [[0] * w for _ in range(k)]
+        self.n = [[0] * w for _ in range(k)]
+        self.epoch = [0] * k
+
+    def update(self, key: int, writer: int, delta: int) -> None:
+        self.tok[key][writer] += 1
+        if delta >= 0:
+            self.p[key][writer] += delta
+        else:
+            self.n[key][writer] -= delta
+
+    def remove(self, key: int) -> None:
+        self.seen[key] = [
+            max(s, t) for s, t in zip(self.seen[key], self.tok[key])
+        ]
+
+    def contains(self, key: int) -> bool:
+        return any(
+            t > -1 and t > s for t, s in zip(self.tok[key], self.seen[key])
+        )
+
+    def value(self, key: int) -> int:
+        return sum(self.p[key]) - sum(self.n[key])
+
+    def join(self, other: "_MapMirror") -> None:
+        for k in range(self.k):
+            if other.epoch[k] > self.epoch[k]:
+                # reset-wins: the higher epoch takes the key wholesale
+                self.tok[k] = list(other.tok[k])
+                self.seen[k] = list(other.seen[k])
+                self.p[k] = list(other.p[k])
+                self.n[k] = list(other.n[k])
+                self.epoch[k] = other.epoch[k]
+            elif other.epoch[k] == self.epoch[k]:
+                self.tok[k] = [max(a, b) for a, b in zip(self.tok[k], other.tok[k])]
+                self.seen[k] = [max(a, b) for a, b in zip(self.seen[k], other.seen[k])]
+                self.p[k] = [max(a, b) for a, b in zip(self.p[k], other.p[k])]
+                self.n[k] = [max(a, b) for a, b in zip(self.n[k], other.n[k])]
+            # else: ours is newer — ignore the stale row
+
+    def reset(self, key: int) -> None:
+        self.tok[key] = [-1] * self.w
+        self.seen[key] = [-1] * self.w
+        self.p[key] = [0] * self.w
+        self.n[key] = [0] * self.w
+        self.epoch[key] += 1
+
+    def copy(self) -> "_MapMirror":
+        import copy
+
+        return copy.deepcopy(self)
+
+
+class MapSoakRunner:
+    """One seeded adversarial map-workload schedule (see module docstring
+    round-4 section; skeleton parallels SetSoakRunner)."""
+
+    def __init__(
+        self,
+        n: int = 4,
+        seed: int = 0,
+        n_keys: int = 12,
+        p_update: float = 0.3,
+        p_remove: float = 0.16,
+        p_join: float = 0.22,
+        p_kill: float = 0.04,
+        p_revive: float = 0.06,
+        p_snapshot: float = 0.05,
+        p_restore: float = 0.05,
+        p_barrier: float = 0.12,
+    ):
+        from crdt_tpu.models import ormap, ormap_gc, pncounter
+
+        self.rng = random.Random(seed)
+        self.n = n
+        self.n_keys = n_keys
+        self.value_zero = pncounter.zero(n)
+        self.vjoin = jax.vmap(pncounter.join)
+        self.states = [
+            ormap_gc.wrap(ormap.empty(n_keys, n, self.value_zero))
+            for _ in range(n)
+        ]
+        self.mirrors = [_MapMirror(n_keys, n) for _ in range(n)]
+        # stale-snapshot slots: (MapGc, _MapMirror) per replica, or None
+        self.saved = [None] * n
+        self.alive = [True] * n
+        self.p = (p_update, p_remove, p_join, p_kill, p_revive,
+                  p_snapshot, p_restore, p_barrier)
+        self.report = MapSoakReport()
+
+    # ---- helpers ----
+
+    def _check(self, i: int, where: str) -> None:
+        from crdt_tpu.models import ormap_gc, pncounter
+
+        got_c = np.asarray(ormap_gc.contains(self.states[i])).tolist()
+        want_c = [self.mirrors[i].contains(k) for k in range(self.n_keys)]
+        assert got_c == want_c, (
+            f"M1 presence diverged at replica {i} after {where}: "
+            f"device {got_c} != mirror {want_c}"
+        )
+        vals = np.asarray(pncounter.value(self.states[i].map.values))
+        for k in range(self.n_keys):
+            if want_c[k]:
+                assert int(vals[k]) == self.mirrors[i].value(k), (
+                    f"M1 value diverged at replica {i} key {k} after "
+                    f"{where}: device {int(vals[k])} != mirror "
+                    f"{self.mirrors[i].value(k)}"
+                )
+
+    # ---- actions ----
+
+    def _update(self) -> None:
+        from crdt_tpu.models import ormap_gc, pncounter
+
+        i = self.rng.randrange(self.n)
+        if not self.alive[i]:
+            return
+        k = self.rng.randrange(self.n_keys)
+        delta = self.rng.randint(-5, 5)
+        self.states[i] = ormap_gc.update(
+            self.states[i], k, i, lambda v: pncounter.add(v, i, delta)
+        )
+        self.mirrors[i].update(k, i, delta)
+        self.report.updates += 1
+        self._check(i, "update")
+
+    def _remove(self) -> None:
+        from crdt_tpu.models import ormap_gc
+
+        i = self.rng.randrange(self.n)
+        if not self.alive[i]:
+            return
+        present = [
+            k for k in range(self.n_keys) if self.mirrors[i].contains(k)
+        ]
+        if not present:
+            return
+        k = self.rng.choice(present)
+        self.states[i] = ormap_gc.remove(self.states[i], k, i)
+        self.mirrors[i].remove(k)
+        self.report.removes += 1
+        self._check(i, "remove")
+
+    def _join(self) -> None:
+        from crdt_tpu.models import ormap_gc
+
+        i = self.rng.randrange(self.n)
+        j = self.rng.randrange(self.n)
+        if i == j or not (self.alive[i] and self.alive[j]):
+            return
+        self.states[i] = ormap_gc.join(
+            self.states[i], self.states[j], self.vjoin
+        )
+        self.mirrors[i].join(self.mirrors[j])
+        self.report.joins += 1
+        self._check(i, "join")
+
+    def _kill(self) -> None:
+        candidates = [i for i in range(self.n) if self.alive[i]]
+        if len(candidates) <= 1:
+            return
+        self.alive[self.rng.choice(candidates)] = False
+        self.report.kills += 1
+
+    def _revive(self) -> None:
+        dead = [i for i in range(self.n) if not self.alive[i]]
+        if not dead:
+            return
+        self.alive[self.rng.choice(dead)] = True
+        self.report.revivals += 1
+
+    def _snapshot(self) -> None:
+        i = self.rng.randrange(self.n)
+        if not self.alive[i]:
+            return
+        self.saved[i] = (self.states[i], self.mirrors[i].copy())
+        self.report.snapshots += 1
+
+    def _restore(self) -> None:
+        """Stale-snapshot revert: the crash model the per-key epochs
+        absorb — a replica comes back holding PRE-BARRIER state and must
+        be re-absorbed by epoch dominance at its next join."""
+        i = self.rng.randrange(self.n)
+        if not self.alive[i] or self.saved[i] is None:
+            return
+        self.states[i], mirror = self.saved[i]
+        self.mirrors[i] = mirror.copy()
+        self.report.restores += 1
+        self._check(i, "restore")
+
+    def _barrier(self) -> None:
+        from crdt_tpu.models import ormap_gc
+
+        sw, n_reset = ormap_gc.reset_barrier(
+            swarm.make(
+                jax.tree.map(lambda *xs: jnp.stack(xs), *self.states),
+                jnp.asarray(self.alive),
+            ),
+            self.vjoin, self.value_zero,
+        )
+        if not all(self.alive):
+            # full-fleet rule: a barrier with a dead member never executes
+            # (counted apart from executed-but-nothing-to-reset no-ops)
+            self.report.barriers_skipped += 1
+            return
+        self.states = [
+            jax.tree.map(lambda x: x[i], sw.state) for i in range(self.n)
+        ]
+        # mirror: LUB everyone, reset the stably-removed keys, broadcast
+        lub = self.mirrors[0].copy()
+        for i in range(1, self.n):
+            lub.join(self.mirrors[i])
+        for k in range(self.n_keys):
+            had = any(t > -1 for t in lub.tok[k])
+            if had and not lub.contains(k):
+                lub.reset(k)
+        self.mirrors = [lub.copy() for _ in range(self.n)]
+        self.report.barriers += 1
+        if n_reset:
+            self.report.keys_reset += n_reset
+        else:
+            self.report.barriers_noop += 1
+        for i in range(self.n):
+            self._check(i, "barrier")
+
+    # ---- run ----
+
+    def step(self) -> None:
+        x = self.rng.random()
+        acc = 0.0
+        for p, action in zip(self.p, (
+            self._update, self._remove, self._join, self._kill,
+            self._revive, self._snapshot, self._restore, self._barrier,
+        )):
+            acc += p
+            if x < acc:
+                action()
+                break
+        self.report.steps += 1
+
+    def heal_and_check(self) -> MapSoakReport:
+        from crdt_tpu.models import ormap_gc
+
+        self.alive = [True] * self.n
+        for _ in range(self.n):
+            for i in range(self.n):
+                j = (i + 1) % self.n
+                self.states[i] = ormap_gc.join(
+                    self.states[i], self.states[j], self.vjoin
+                )
+                self.mirrors[i].join(self.mirrors[j])
+        present = {
+            tuple(np.asarray(ormap_gc.contains(self.states[i])).tolist())
+            for i in range(self.n)
+        }
+        assert len(present) == 1, "healed swarm did not converge"
+        for i in range(self.n):
+            self._check(i, "heal")
+        self.report.final_present = int(
+            np.asarray(ormap_gc.contains(self.states[0])).sum()
+        )
+        return self.report
+
+    def run(self, n_steps: int) -> MapSoakReport:
+        for _ in range(n_steps):
+            self.step()  # M4: no step may raise
+        return self.heal_and_check()
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -292,14 +618,20 @@ def main(argv=None) -> int:
     ap.add_argument("--replicas", type=int, default=4)
     ap.add_argument("--capacity", type=int, default=512)
     ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu")
+    ap.add_argument("--workload", choices=["set", "map", "both"],
+                    default="both")
     args = ap.parse_args(argv)
     if args.platform != "ambient":
         jax.config.update("jax_platforms", "cpu")
     for seed in range(args.seeds):
-        runner = SetSoakRunner(
-            n=args.replicas, seed=seed, capacity=args.capacity,
-        )
-        print(f"seed {seed}: {runner.run(args.steps)}")
+        if args.workload in ("set", "both"):
+            runner = SetSoakRunner(
+                n=args.replicas, seed=seed, capacity=args.capacity,
+            )
+            print(f"seed {seed}: {runner.run(args.steps)}")
+        if args.workload in ("map", "both"):
+            mrunner = MapSoakRunner(n=args.replicas, seed=seed)
+            print(f"seed {seed}: {mrunner.run(args.steps)}")
     return 0
 
 

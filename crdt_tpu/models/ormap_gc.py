@@ -1,0 +1,189 @@
+"""OR-Map GC: reclaim the accumulated state of stably-removed keys
+(VERDICT round 3, item 5 — "bounded tombstones on the general map").
+
+What grows on the OR-Map (crdt_tpu.models.ormap) is not table rows — its
+planes are fixed-shape — but the per-key STATE of removed keys: token
+seqs, observation matrices, and above all the value lattice's folded
+history (a removed PN-Counter key keeps its P/N planes forever; the
+module docstring's "re-added key surfaces its accumulated value").  The
+OR-Set/RSeq answer (drop collected rows, crdt_tpu.models.tomb_gc) does
+not transfer: map keys are REUSED identities, not one-shot tags, so any
+reclamation is observable on re-add.  This module therefore makes the
+semantics explicit instead of pretending otherwise:
+
+  **A GC barrier upgrades the map to reset-on-stable-remove**: a key
+  whose removal every member has converged on is reset wholesale —
+  presence planes to empty, value row to the caller's zero — and a key
+  re-added afterwards starts fresh, exactly like a never-used key.
+  Without barriers nothing changes (the plain accumulate-forever
+  semantics).  Deployments wanting Riak-style reset pick a barrier
+  cadence; deployments wanting pure accumulation run none.
+
+Safety machinery (why a reset cannot resurrect or lose concurrent work):
+
+* **Full-fleet barriers only.**  A reset is mintable only when EVERY
+  replica is alive and converged (the network_compact "any unreachable
+  member skips the barrier" rule, crdt_tpu/api/net.py).  tomb_gc's
+  alive-only floors work because (rid, seq) rows above the floor are
+  untouchable; the map's reset discards whole key rows, so the barrier
+  must have seen everyone's contributions first.  A token minted after
+  the remove but before the barrier keeps ``contains`` true and blocks
+  the reset — only keys removed IN THE CONVERGED STATE reset.
+* **Per-key epochs — RESET-WINS.**  ``epoch[k]`` counts resets; the
+  join is the lexicographic product (epoch, planes): higher epoch wins
+  the key wholesale, equal epochs join planes elementwise.  A stale
+  state (a replica reverted to a pre-barrier snapshot) is absorbed:
+  what it held for a reset key at snapshot time was part of the
+  converged state the barrier folded.  An update MINTED ON a stale
+  state after the reset, however, is dominated too — that is the
+  reset-wins semantics, stated plainly: an update racing the barrier
+  itself is protected (its token blocks the reset via full-fleet
+  convergence), but an update performed on a state that had not yet
+  learned of an already-agreed reset loses to it, the same way
+  reset-wins maps in the CRDT literature resolve update‖reset.
+  Deployments wanting update-wins for that race must pull before
+  writing after a restore (the NodeHost boot sequence already does).
+  Epochs advance ONLY through full-fleet barriers, so any two live
+  epochs are comparable (the compactlog/tomb_gc chain-rule
+  discipline).
+
+The reference never reclaims anything (/root/reference/main.go:75 clears
+only a staging buffer); this is the framework capability that keeps a
+long-lived general map's state bounded by its LIVE keys.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from crdt_tpu.models import flags, ormap
+
+
+@struct.dataclass
+class MapGc:
+    """An ORMap plus its per-key reset epoch."""
+
+    map: ormap.ORMap
+    epoch: jax.Array  # int32[K]  resets folded into this key (monotone)
+
+    @property
+    def n_keys(self) -> int:
+        return self.map.n_keys
+
+    @property
+    def n_writers(self) -> int:
+        return self.map.n_writers
+
+
+def wrap(m: ormap.ORMap) -> MapGc:
+    return MapGc(map=m, epoch=jnp.zeros((m.n_keys,), jnp.int32))
+
+
+def _sel(mask: jax.Array, x: jax.Array, y: jax.Array) -> jax.Array:
+    """Per-key select: broadcast a [K] mask over [K, ...] planes."""
+    return jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - 1)), x, y)
+
+
+def join(a: MapGc, b: MapGc, value_join_batched: Callable) -> MapGc:
+    """Epoch-guarded product join (see module docstring): per key, the
+    higher epoch wins wholesale; equal epochs join planes elementwise.
+    ACI because it is the join of the lexicographic (epoch, planes)
+    product lattice — epochs only advance through full-fleet barriers,
+    so dominance never discards unaccounted-for state."""
+    j = ormap.join(a.map, b.map, value_join_batched)
+    eq = a.epoch == b.epoch
+    ta = a.epoch > b.epoch
+
+    def pick(xa, xb, xj):
+        return _sel(eq, xj, _sel(ta, xa, xb))
+
+    presence = flags.TokenPlane(
+        tok=pick(a.map.presence.tok, b.map.presence.tok, j.presence.tok),
+        obs=pick(a.map.presence.obs, b.map.presence.obs, j.presence.obs),
+    )
+    values = jax.tree.map(pick, a.map.values, b.map.values, j.values)
+    return MapGc(
+        map=ormap.ORMap(presence=presence, values=values),
+        epoch=jnp.maximum(a.epoch, b.epoch),
+    )
+
+
+def joiner(value_join_batched: Callable) -> Callable:
+    return lambda a, b: join(a, b, value_join_batched)
+
+
+# ---- passthroughs (the MapGc is an ORMap plus bookkeeping) ------------------
+
+
+def update(g: MapGc, key, writer, apply_fn: Callable) -> MapGc:
+    return g.replace(map=ormap.update(g.map, key, writer, apply_fn))
+
+
+def remove(g: MapGc, key, writer) -> MapGc:
+    return g.replace(map=ormap.remove(g.map, key, writer))
+
+
+def contains(g: MapGc) -> jax.Array:
+    return ormap.contains(g.map)
+
+
+def get(g: MapGc, key) -> Any:
+    return ormap.get(g.map, key)
+
+
+# ---- the reset barrier ------------------------------------------------------
+
+
+def reset_keys(g: MapGc, keys_mask: jax.Array, value_zero: Any) -> MapGc:
+    """Reset the masked keys to pristine: presence planes emptied, value
+    rows to ``value_zero``, epoch bumped.  Callers go through
+    :func:`reset_barrier` — a reset outside a full-fleet converged
+    barrier breaks the epoch chain rule."""
+    m = g.map
+    presence = flags.TokenPlane(
+        tok=_sel(keys_mask, jnp.full_like(m.presence.tok, -1), m.presence.tok),
+        obs=_sel(keys_mask, jnp.full_like(m.presence.obs, -1), m.presence.obs),
+    )
+    zero_rows = jax.tree.map(
+        lambda z, l: jnp.broadcast_to(z[None], l.shape), value_zero, m.values
+    )
+    values = jax.tree.map(
+        lambda z, l: _sel(keys_mask, z, l), zero_rows, m.values
+    )
+    return MapGc(
+        map=ormap.ORMap(presence=presence, values=values),
+        epoch=g.epoch + keys_mask.astype(jnp.int32),
+    )
+
+
+def reset_barrier(
+    sw, value_join_batched: Callable, value_zero: Any
+) -> Tuple[Any, int]:
+    """One swarm-wide reset barrier over a Swarm of batched MapGc states.
+
+    Full-fleet rule: if ANY replica is dead the barrier is a no-op
+    (returns ``(sw, 0)``) — reset safety needs every contribution folded
+    first (module docstring).  Otherwise: converge everyone through the
+    epoch-guarded join, reset every key that is removed in the converged
+    state (and has history worth reclaiming), bump its epoch, and
+    broadcast the result to the whole fleet.  Returns (swarm, n_reset).
+    """
+    if not bool(np.asarray(sw.alive).all()):
+        return sw, 0
+    r = jax.tree.leaves(sw.state)[0].shape[0]
+    acc = jax.tree.map(lambda x: x[0], sw.state)
+    for i in range(1, r):
+        acc = join(acc, jax.tree.map(lambda x: x[i], sw.state),
+                   value_join_batched)
+    had_history = (acc.map.presence.tok > -1).any(axis=-1)
+    removed = had_history & ~ormap.contains(acc.map)
+    n_reset = int(removed.sum())
+    top = reset_keys(acc, removed, value_zero)
+    state = jax.tree.map(
+        lambda t: jnp.broadcast_to(t[None], (r,) + t.shape), top
+    )
+    return sw.replace(state=state), n_reset
