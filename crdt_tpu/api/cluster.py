@@ -44,6 +44,15 @@ class LocalCluster:
             )
             for i in range(self.config.n_replicas)
         ]
+        # set-lattice siblings (crdt_tpu.api.setnode), gossiped alongside
+        # the KV surface — the demo's flagship-extension visibility
+        # (round-3 verdict item 8); cheap until first used
+        from crdt_tpu.api.setnode import SetNode
+
+        self.set_nodes = [
+            SetNode(rid=self.config.rid_base + i, metrics=self.metrics)
+            for i in range(self.config.n_replicas)
+        ]
         self._rng = random.Random(self.config.seed)
         self._ticks = 0
         self._threads: List[threading.Thread] = []
@@ -80,12 +89,24 @@ class LocalCluster:
         if peer is None or peer is node or not peer.alive:
             self.metrics.inc("gossip_skipped")
             return False
-        return pull_round(
+        merged = pull_round(
             node,
             lambda since: peer.gossip_payload(since=since),
             self.metrics,
             delta=self.config.delta_gossip,
         )
+        # set-lattice pull riding the same round (KV result returned —
+        # the surfaces' freshness is never conflated, api/net.py rule)
+        peer_idx = self.nodes.index(peer)
+        sn, psn = self.set_nodes[idx], self.set_nodes[peer_idx]
+        if sn.alive and psn.alive:
+            fresh = sn.receive(
+                psn.gossip_payload(since=sn.version_vector())
+            )
+            self.metrics.inc(
+                "set_gossip_rounds" if fresh else "set_gossip_noop"
+            )
+        return merged
 
     def tick(self) -> int:
         """One gossip round for every replica; returns merges performed.
@@ -95,6 +116,9 @@ class LocalCluster:
         every = self.config.compact_every
         if every and self._ticks % every == 0:
             self.compact()
+        sce = self.config.set_collect_every
+        if sce and self._ticks % sce == 0:
+            self.set_collect()
         return merges
 
     def compact(self) -> Dict[int, int]:
@@ -128,6 +152,35 @@ class LocalCluster:
             for n in alive:
                 n.compact(frontier)
             return frontier
+
+    def set_collect(self) -> Dict[int, int]:
+        """One swarm-wide set GC barrier (setnode.set_barrier math: min
+        over member vvs, chain-ruled; any dead member skips — stability
+        cannot be proven without it)."""
+        from crdt_tpu.api.setnode import set_barrier
+
+        with self._barrier_lock:
+            coord = self.set_nodes[0]
+            if not coord.alive:
+                return {}
+            floor = set_barrier(coord, [
+                sn.vv_snapshot() if sn.alive else None
+                for sn in self.set_nodes[1:]
+            ])
+            if not floor:
+                self.metrics.inc("set_collect_skipped")
+                return {}
+            for sn in self.set_nodes:
+                if sn.alive:
+                    sn.collect(floor)
+            return floor
+
+    def set_converged(self) -> bool:
+        members = [
+            sn.members() for sn in self.set_nodes if sn.alive
+        ]
+        members = [m for m in members if m is not None]
+        return all(m == members[0] for m in members[1:]) if members else True
 
     def converged(self) -> bool:
         states = [n.get_state() for n in self.nodes if n.alive]
@@ -170,6 +223,9 @@ class LocalCluster:
                 every = self.config.compact_every
                 if idx == 0 and every and rounds % every == 0:
                     self.compact()
+                sce = self.config.set_collect_every
+                if idx == 0 and sce and rounds % sce == 0:
+                    self.set_collect()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.metrics.inc("gossip_loop_errors")
                 self.errors.append(e)

@@ -45,6 +45,35 @@ class WorkloadGenerator:
                 cluster.tick()
         return accepted
 
+    # ---- set-lattice drive (demo: /set/add + /set/remove) ----
+
+    def next_set_op(self) -> Tuple[str, str, int]:
+        """Returns (op, elem, target): 65% adds, 35% observed-removes over
+        a small element universe (same spirit as the KV workload's random
+        single-key commands)."""
+        c = self.config
+        op = "add" if self._rng.random() < 0.65 else "remove"
+        elem = "s" + c.key_alphabet[self._rng.randrange(len(c.key_alphabet))]
+        return op, elem, self._rng.randrange(c.n_replicas)
+
+    def drive_set_http(self, urls: List[str], n_ops: int,
+                       timeout: float = 5.0) -> int:
+        accepted = 0
+        for _ in range(n_ops):
+            op, elem, target = self.next_set_op()
+            req = urllib.request.Request(
+                urls[target % len(urls)] + f"/set/{op}",
+                data=json.dumps({"elem": elem}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as res:
+                    accepted += res.status == 200
+            except Exception:
+                pass  # dead replica: skipped
+        return accepted
+
     # ---- HTTP drive (works against the Go reference too) ----
 
     def drive_http(self, urls: List[str], n_writes: int, timeout: float = 5.0) -> int:
