@@ -187,7 +187,8 @@ def run_daemon(args) -> int:
     # same cache fill (harmless duplicate work).
     import threading as _threading
 
-    _threading.Thread(target=host.seq_node.warmup, daemon=True).start()
+    warm_t = _threading.Thread(target=host.seq_node.warmup, daemon=True)
+    warm_t.start()
     print(f"replica rid={rid} (base {args.rid}, incarnation {incarnation}, "
           f"restored={host.restored}) serving on {host.url}, "
           f"{len(peers)} peer(s)", flush=True)
@@ -198,6 +199,10 @@ def run_daemon(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        # let the warmup finish before teardown: exiting the process while
+        # the thread is inside an XLA compile aborts (pthread teardown in
+        # native code — "FATAL: exception not rethrown", found by CI)
+        warm_t.join(timeout=120)
         host.stop()
     state = host.node.get_state()
     print(f"final: state_keys={len(state) if state else 0}")
