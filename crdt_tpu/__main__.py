@@ -50,6 +50,7 @@ def run_demo(args) -> int:
         compact_every=args.compact_every,
         delta_gossip=not args.full_gossip,
         set_collect_every=args.set_collect_every if args.with_sets else 0,
+        seq_collect_every=args.seq_collect_every if args.with_seqs else 0,
     )
     cluster = LocalCluster(cfg)
     http = HttpCluster(cluster)
@@ -65,11 +66,14 @@ def run_demo(args) -> int:
     writes = 0
     last_report = time.time()
     set_ops = 0
+    seq_ops = 0
     try:
         while t_end is None or time.time() < t_end:
             writes += wg.drive_http(urls, 1)
             if args.with_sets:
                 set_ops += wg.drive_set_http(urls, 1)
+            if args.with_seqs:
+                seq_ops += wg.drive_seq_http(urls, 1)
             if time.time() - last_report >= args.report_every:
                 converged = cluster.converged()
                 alive = [s for s in cluster.states() if s is not None]
@@ -90,6 +94,14 @@ def run_demo(args) -> int:
                         f"set_collections="
                         f"{m.get('set_collections', 0)}"
                     )
+                if args.with_seqs:
+                    items = cluster.seq_nodes[0].items() or []
+                    line += (
+                        f" | seq_ops={seq_ops} len={len(items)} "
+                        f"seq_converged={cluster.seq_converged()} "
+                        f"seq_collections="
+                        f"{m.get('seq_collections', 0)}"
+                    )
                 print(line)
                 last_report = time.time()
             time.sleep(cfg.write_period_ms / 1000.0)
@@ -103,12 +115,14 @@ def run_demo(args) -> int:
     # miss — especially under --reference-topology's dead-port friend list)
     ok = cluster.converged()
     set_ok = cluster.set_converged() if args.with_sets else True
+    seq_ok = cluster.seq_converged() if args.with_seqs else True
     for _ in range(64 * len(cluster.nodes)):
-        if ok and set_ok:
+        if ok and set_ok and seq_ok:
             break
         cluster.tick()
         ok = cluster.converged()
         set_ok = cluster.set_converged() if args.with_sets else True
+        seq_ok = cluster.seq_converged() if args.with_seqs else True
     alive = [s for s in cluster.states() if s is not None]
     line = (f"final: writes={writes} converged={ok} "
             f"state_keys={len(alive[0]) if alive else 0}")
@@ -116,10 +130,14 @@ def run_demo(args) -> int:
         members = cluster.set_nodes[0].members() or []
         line += (f" | set_ops={set_ops} set_converged={set_ok} "
                  f"members={len(members)}")
+    if args.with_seqs:
+        items = cluster.seq_nodes[0].items() or []
+        line += (f" | seq_ops={seq_ops} seq_converged={seq_ok} "
+                 f"len={len(items)}")
     print(line)
     if args.dump_state and alive:
         print(json.dumps(alive[0], sort_keys=True))
-    return 0 if ok and set_ok else 1
+    return 0 if ok and set_ok and seq_ok else 1
 
 
 def run_daemon(args) -> int:
@@ -145,12 +163,18 @@ def run_daemon(args) -> int:
               "(exactly one daemon schedules set GC barriers)",
               file=sys.stderr)
         return 2
+    if args.seq_collect_every and not args.coordinator:
+        print("--seq-collect-every in --daemon mode requires --coordinator "
+              "(exactly one daemon schedules seq GC barriers)",
+              file=sys.stderr)
+        return 2
     cfg = ClusterConfig(
         gossip_period_ms=args.gossip_ms,
         compact_every=args.compact_every,
         delta_gossip=not args.full_gossip,
         go_compat_gossip=args.go_compat_gossip,
         set_collect_every=args.set_collect_every,
+        seq_collect_every=args.seq_collect_every,
     )
     peers = [u for u in (args.peers or "").split(",") if u]
     rid = args.rid
@@ -247,6 +271,14 @@ def main(argv=None) -> int:
                     help="demo: drive the OR-Set lattice alongside the KV "
                          "workload (/set/add + /set/remove on random "
                          "replicas) and report set convergence")
+    ap.add_argument("--with-seqs", action="store_true",
+                    help="demo: drive the sequence lattice alongside the "
+                         "KV workload (/seq/insert + /seq/remove) and "
+                         "report sequence convergence")
+    ap.add_argument("--seq-collect-every", type=int, default=0,
+                    help="run a sequence GC barrier every N gossip rounds "
+                         "(demo: replica 0's loop, needs --with-seqs; "
+                         "daemon: coordinator only)")
     ap.add_argument("--go-compat-gossip", action="store_true",
                     help="daemon: emit full-dump gossip with bare integer-ms "
                          "keys so an ORIGINAL Go peer can pull from this "

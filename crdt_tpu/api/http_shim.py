@@ -85,7 +85,10 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
 
         @property
         def seq_node(self):
-            return getattr(admin, "seq_node", None)
+            if admin is not None:
+                return getattr(admin, "seq_node", None)
+            nodes = getattr(cluster, "seq_nodes", None)
+            return nodes[idx] if nodes else None
 
         def _parse_vv_query(self, url):
             """?vv=<json {rid: seq}> -> dict, None (absent), or the string

@@ -74,6 +74,34 @@ class WorkloadGenerator:
                 pass  # dead replica: skipped
         return accepted
 
+    # ---- sequence-lattice drive (demo: /seq/insert + /seq/remove) ----
+
+    def drive_seq_http(self, urls: List[str], n_ops: int,
+                       timeout: float = 5.0) -> int:
+        """70% inserts at a random index (daemon clamps), 30% removes."""
+        accepted = 0
+        for _ in range(n_ops):
+            target = self._rng.randrange(self.config.n_replicas)
+            if self._rng.random() < 0.7:
+                body = {"elem": f"q{self._rng.randrange(1 << 20)}",
+                        "index": self._rng.randint(0, 20)}
+                path = "/seq/insert"
+            else:
+                body = {"index": self._rng.randint(0, 20)}
+                path = "/seq/remove"
+            req = urllib.request.Request(
+                urls[target % len(urls)] + path,
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as res:
+                    accepted += res.status == 200
+            except Exception:
+                pass  # dead replica: skipped
+        return accepted
+
     # ---- HTTP drive (works against the Go reference too) ----
 
     def drive_http(self, urls: List[str], n_writes: int, timeout: float = 5.0) -> int:
