@@ -32,3 +32,20 @@ def test_daemon_mode_boots_and_exits():
     assert p.returncode == 0, p.stdout + p.stderr
     assert "replica rid=7 (base 7, incarnation 0, restored=False) serving on" in p.stdout
     assert "final: state_keys=0" in p.stdout
+
+
+def test_demo_mode_all_lattice_surfaces(request):
+    """--with-sets + --with-seqs: the reference-style demo drives all
+    three lattice surfaces (KV + OR-Set + sequence) with scheduled GC
+    barriers and converges every one of them (round-4: the flagship
+    extensions visible in the demo, not only in soaks)."""
+    p = _run([
+        "--replicas", "3", "--ephemeral-ports", "--duration", "8",
+        "--gossip-ms", "60", "--write-ms", "30", "--report-every", "2",
+        "--seed", "5", "--with-sets", "--with-seqs",
+        "--set-collect-every", "4", "--seq-collect-every", "5",
+    ], timeout=240)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "set_converged=True" in p.stdout.splitlines()[-1]
+    assert "seq_converged=True" in p.stdout.splitlines()[-1]
+    assert "converged=True" in p.stdout.splitlines()[-1]
