@@ -365,13 +365,52 @@ class SeqNode:
         self._depth = new_depth
         self.metrics.inc("seq_widen")
 
+    @staticmethod
+    def _validate_op(ident, op) -> None:
+        """Wire-content validation, run BEFORE any state mutates (a raise
+        mid-ingest must leave the node exactly as it was).  Enforces the
+        allocator invariant the GC machinery rests on: an insert path's
+        DEEPEST level carries the element's own (rid, seq), which must
+        equal the op identity (rseq.alloc_key mints them equal; the
+        stamping repeats it).  A hostile peer shipping a mismatch would
+        desynchronize the table's GC identity (last-level columns,
+        rseq.GC_ADAPTER.rid_seq) from the vv/floor accounting — breaking
+        absence-implies-collected silently.  Loud instead, like
+        ReplicaNode.receive on a malformed wire key."""
+        rid, seq = ident
+        if "ins" in op:
+            levels = _levels_from_wire(op["path"])  # raises on bad shape
+            if not levels:
+                raise ValueError(f"op {ident}: empty path")
+            if tuple(levels[-1][1:]) != (rid, seq):
+                raise ValueError(
+                    f"op {ident}: path's own level carries identity "
+                    f"{levels[-1][1:]} != the op identity (hostile or "
+                    "corrupt wire — honest allocators mint them equal)"
+                )
+            for pos, _, _ in levels:
+                if not 0 <= pos < rseq.POS_MAX:
+                    raise ValueError(
+                        f"op {ident}: position {pos} outside the 60-bit "
+                        "coordinate space"
+                    )
+        elif "del" in op:
+            t = op["del"]
+            if len(t) != 2:
+                raise ValueError(f"op {ident}: del target {t!r} is not a "
+                                 "(rid, seq) pair")
+            int(t[0]); int(t[1])  # raises on non-numeric
+        else:
+            raise ValueError(f"op {ident}: unknown op kind {sorted(op)}")
+
     def _stamped_row(self, ident, op) -> Tuple[int, ...]:
         """The op's full key row at the CURRENT table depth (widening
-        first if the wire path is deeper than the table)."""
+        first if the wire path is deeper than the table).  Content was
+        validated by _validate_op before any mutation."""
         levels = _levels_from_wire(op["path"])
+        rid, seq = ident
         if len(levels) > self._depth:
             self._widen_locked(len(levels))
-        rid, seq = ident
         return rseq._stamp(levels, rid, seq, self._depth)
 
     def _ingest_locked(self, rows) -> int:
@@ -381,7 +420,15 @@ class SeqNode:
         ins_rows: List[Tuple[Tuple[int, ...], int, bool]] = []
         tomb: List[Tuple[int, int]] = []
         staged: List[Tuple[Tuple[int, int], Dict[str, Any]]] = []
-        for ident, op in sorted(rows, key=lambda r: (r[0][0], r[0][1])):
+        ordered = sorted(rows, key=lambda r: (r[0][0], r[0][1]))
+        # pure validation pass FIRST: a malformed row must reject the
+        # whole batch before anything mutates (host records and device
+        # table move together or not at all)
+        for ident, op in ordered:
+            if ident in self._ops or ident[1] <= self._floor.get(ident[0], -1):
+                continue
+            self._validate_op(ident, op)
+        for ident, op in ordered:
             rid, seq = ident
             if ident in self._ops:
                 continue  # re-delivery

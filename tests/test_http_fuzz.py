@@ -152,3 +152,83 @@ def test_valid_json_non_dict_peer_is_skipped(body):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+# ---- extension-surface fuzz (/set/*, /seq/* — round 4) ----------------------
+
+
+@pytest.mark.parametrize("path,bodies", [
+    ("/set/add", [b"", b"[]", b"42", b"{bad", b'{"elem": {"a": 1}}']),
+    ("/set/collect", [b"{bad", b'{"floor": "x"}', b'{"floor": {"a": "b"}}']),
+    ("/seq/insert", [b"", b"[]", b"{bad", b'{"elem": "x", "index": "q"}']),
+    ("/seq/remove", [b"{bad", b'{"index": null}', b'{"index": "x"}']),
+    ("/seq/collect", [b"{bad", b'{"floor": {"a": "b"}}']),
+])
+def test_bad_extension_bodies_never_500(served, path, bodies):
+    """Malformed bodies on the set/seq surfaces get 4xx/2xx — never a
+    500 and never a dead server thread."""
+    cluster, urls = served
+    for body in bodies:
+        code, _ = _req(urls[0] + path, method="POST", data=body)
+        assert code in (200, 400, 502), (path, body, code)
+    # the server is still healthy afterwards
+    assert _req(urls[0] + "/ping")[0] == 200
+    assert _req(urls[0] + "/set")[0] == 200
+    assert _req(urls[0] + "/seq")[0] == 200
+
+
+def test_bad_extension_vv_queries(served):
+    cluster, urls = served
+    for path in ("/set/gossip", "/seq/gossip"):
+        code, _ = _req(urls[0] + path + "?vv=%7Bbad")
+        assert code == 400
+        code, _ = _req(urls[0] + path)
+        assert code == 200
+
+
+def test_seq_hostile_payloads_raise_loudly_and_mutate_nothing():
+    """Malformed seq wire CONTENT (inside valid JSON) raises like
+    ReplicaNode.receive — and the validation pass runs before any state
+    mutates, so a bad row rejects its whole batch atomically."""
+    from crdt_tpu.api.seqnode import SeqNode
+
+    n = SeqNode(rid=0)
+    n.append("keep")
+    before_items = n.items()
+    before_vv = n.version_vector()
+    good = {"ins": "x", "path": [[1, 2, 1, 0]]}
+    hostile = [
+        {"1:0": {"ins": "a"}},                                # no path
+        {"1:0": {"ins": "a", "path": []}},                    # empty path
+        {"1:0": {"ins": "a", "path": [[1, 2, 9, 9]]}},        # identity forgery
+        {"1:0": {"ins": "a", "path": [[1, 2]]}},              # wrong arity
+        {"1:0": {"ins": "a", "path": [["x", 0, 1, 0]]}},      # non-numeric
+        {"1:0": {"del": [1]}},                                # bad target
+        {"1:0": {"nop": 1}},                                  # unknown kind
+        {"garbage": good},                                    # bad wire key
+        # a GOOD op batched with a bad one: the batch must reject whole
+        {"1:0": dict(good), "1:1": {"ins": "b", "path": [[1, 3, 5, 5]]}},
+    ]
+    for payload in hostile:
+        with pytest.raises((ValueError, KeyError, TypeError)):
+            n.receive(payload)
+        assert n.items() == before_items, payload
+        assert n.version_vector() == before_vv, payload
+    # and a clean payload still lands afterwards
+    assert n.receive({"1:0": good}) == 1
+    assert "x" in n.items()
+
+
+def test_set_hostile_payloads_raise_loudly():
+    from crdt_tpu.api.setnode import SetNode
+
+    n = SetNode(rid=0)
+    n.add("keep")
+    before = n.members()
+    for payload in (
+        {"garbage": {"add": "a"}},
+        {"1:x": {"add": "a"}},
+    ):
+        with pytest.raises(ValueError):
+            n.receive(payload)
+        assert n.members() == before
