@@ -1,4 +1,5 @@
 from crdt_tpu.api.node import ReplicaNode  # noqa: F401
 from crdt_tpu.api.cluster import LocalCluster  # noqa: F401
 from crdt_tpu.api.net import NetworkAgent, NodeHost, RemotePeer  # noqa: F401
+from crdt_tpu.api.seqnode import SeqNode  # noqa: F401
 from crdt_tpu.api.setnode import SetNode  # noqa: F401
