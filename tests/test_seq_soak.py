@@ -37,5 +37,15 @@ def test_seq_soak_long(request):
     # long-mode suites (tests/test_parity_fuzz.py)
     if not (request.config.getoption("--long") or os.environ.get("CRDT_LONG")):
         pytest.skip("long soak: pytest --long (or CRDT_LONG=1)")
-    for seed in range(6):
-        SeqSoakRunner(n=4, seed=seed, capacity=1024).run(1000)
+    # engine split: the columnar engine's CPU INTERPRET emulation costs
+    # ~10-20x the generic jit path per join at capacity 1024, so all-
+    # columnar long seeds run for hours.  Two columnar seeds keep long-
+    # mode aging of the default engine (equivalence is pinned bit-exactly
+    # by tests/test_rseq_engine.py; on TPU the engine is compiled Mosaic,
+    # where the ratio INVERTS — see PERF.md); the remaining seeds stress
+    # the allocator/GC schedule on the generic path at full length.
+    for seed in range(2):
+        SeqSoakRunner(n=4, seed=seed, capacity=512, engine="auto").run(400)
+    for seed in range(2, 6):
+        SeqSoakRunner(n=4, seed=seed, capacity=1024,
+                      engine="generic").run(1000)
