@@ -108,6 +108,12 @@ def _kernel_gate():
 
     try:
         hw_selftest.run(full=False, log=log)
+    except BaseException as exc:
+        # the committed artifact must be self-describing on failure — a
+        # reader should never have to notice a MISSING "ALL OK" line to
+        # tell a failed run from a green one
+        lines.append(f"hw_selftest: FAILED: {exc!r}")
+        raise
     finally:
         stamp = datetime.datetime.now(datetime.timezone.utc).isoformat(
             timespec="seconds"

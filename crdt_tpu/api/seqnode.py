@@ -106,6 +106,7 @@ class SeqNode:
         self._capacity = capacity
         self._n_writers = n_writers
         self._depth = depth
+        self._init_depth = depth  # restore target; ingest re-widens on demand
         self.gc = tomb_gc.wrap(rseq.empty(capacity, depth=depth), n_writers)
         # host op records: identity -> op dict (wire-shaped):
         #   insert: {"ins": elem_str, "path": [[hi, lo, rid, seq], ...]}
@@ -534,6 +535,11 @@ class SeqNode:
                 self._by_writer[w] = [
                     e2 for e2 in lst if e2[0] not in dropped
                 ]
+        # floor coverage alone blocks re-ingestion (_ingest_locked skips
+        # seq <= floor), so tombstone-index entries at or below the floor
+        # — including suppression-derived ones with no remove record —
+        # are dead weight; prune them so long-lived nodes stay bounded
+        self._tombstoned = {t for t in self._tombstoned if not covered(t)}
 
     def _adopt_floor_locked(
         self,
@@ -567,7 +573,9 @@ class SeqNode:
                 if t[1] <= remote_floor.get(t[0], -1) and t not in payload_inserts:
                     stale.append(t)
             if stale:
-                self._tombstoned.update(stale)
+                # device rows get suppressed; the host tombstone index is
+                # NOT updated — these identities sit at/below the adopted
+                # floor, and floor coverage already blocks re-ingestion
                 self.gc = self.gc.replace(
                     inner=_tombstone_idents(self.gc.inner, stale)
                 )
@@ -612,7 +620,11 @@ class SeqNode:
             self._by_writer = {}
             self._vv = {}
             self._tombstoned = set()
-            self._depth = rseq.DEPTH
+            # rebuild at the CONSTRUCTOR depth, not the module default — a
+            # deliberately shallow node must not change shape across a
+            # restore; _ingest_locked widens on demand if the snapshot's
+            # paths need more levels
+            self._depth = self._init_depth
             self.gc = tomb_gc.wrap(
                 rseq.empty(self._capacity, depth=self._depth),
                 self._n_writers,

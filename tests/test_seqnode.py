@@ -227,3 +227,44 @@ def test_http_surface(hosts):
     # vv surface
     code, out = _http(a.url + "/seq/vv")
     assert code == 200 and "vv" in json.loads(out)
+
+
+def test_snapshot_restore_keeps_constructor_depth():
+    """Round-5 ADVICE fix: a deliberately shallow node must restore at its
+    constructor depth (ingest re-widens on demand), not the module default."""
+    from crdt_tpu.models import rseq
+
+    a = SeqNode(rid=0, depth=2)
+    a.append("a")
+    snap = json.loads(json.dumps(a.to_snapshot()))
+    b = SeqNode(rid=0, depth=2)
+    b.from_snapshot(snap)
+    assert b._depth == 2
+    assert b.items() == ["a"]
+    # default-depth nodes still restore at the default
+    c = SeqNode(rid=1)
+    c.from_snapshot(json.loads(json.dumps(SeqNode(rid=1).to_snapshot())))
+    assert c._depth == rseq.DEPTH
+
+
+def test_tombstone_index_pruned_by_floor():
+    """Round-5 ADVICE fix: _tombstoned entries covered by the floor —
+    including suppression-derived identities with no remove record — are
+    pruned at floor application, so long-lived nodes stay bounded."""
+    a, b, c = SeqNode(rid=0), SeqNode(rid=1), SeqNode(rid=2)
+    for x in "abc":
+        a.append(x)
+    sync(a, b)
+    sync(a, c)
+    b.remove_at(1)
+    sync(a, b)
+    floor = seq_barrier(a, [b.vv_snapshot()])
+    a.collect(floor)
+    b.collect(floor)
+    assert a._tombstoned == set()
+    assert b._tombstoned == set()
+    # the suppression path (full payload to the stale partitioned node)
+    # must not leave permanent synthetic entries either
+    c.receive(a.gossip_payload(since=c.version_vector()))
+    assert c.items() == ["a", "c"]
+    assert c._tombstoned == set()
