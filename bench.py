@@ -90,7 +90,8 @@ def _kernel_gate():
     raises, so a kernel regression cannot ship a BENCH_r* record.
 
     The gated subset covers EVERY fused path (OR-combine, lex2, columnar
-    OpLog, shard_map sharded_converge, lexN RSeq, GC-aware RSeq join) and
+    OpLog, shard_map sharded_converge, lexN RSeq, GC-aware RSeq join,
+    sharded GC-aware converge) and
     the log is written to SELFTEST_HW.txt next to this file — "all checks
     green" is a committed artifact, not a commit-message claim."""
     if jax.default_backend() == "cpu":

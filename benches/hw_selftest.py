@@ -227,14 +227,44 @@ def check_gc_rseq():
     _log("  GC-aware lexN RSeq join (floor suppression): OK")
 
 
+def check_sharded_gc():
+    """The GC-aware converge under shard_map on a 1-device mesh (compiled
+    Mosaic) vs the single-device gc_converge_checked — the production
+    tomb_gc barrier path's multichip program (round-5)."""
+    from benches.bench_rseq_columnar import make_swarm_planes
+    from crdt_tpu.models import rseq_engine
+
+    c, r, w, seq_bits = 64, 16, 8, 20
+    col = make_swarm_planes(17, c, r, depth=3)
+    rng = np.random.default_rng(17)
+    floor = jnp.asarray(rng.integers(-1, 200, (w, r)), jnp.int32)
+    cg = rseq_engine.ColumnarGc(col=col, floor=floor)
+    alive = jnp.asarray([True] * (r - 1) + [False])
+    m = mesh_lib.make_mesh(1)
+    step = rseq_engine.sharded_gc_converge(m, depth=3, seq_bits=seq_bits)
+    out, _ = step(cg, alive)
+    want, _ = rseq_engine.gc_converge_checked(cg, alive)
+    np.testing.assert_array_equal(
+        np.asarray(out.col.keys), np.asarray(want.col.keys)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.col.elem), np.asarray(want.col.elem)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.floor), np.asarray(want.floor)
+    )
+    _log("  sharded GC-aware converge (shard_map + Mosaic): OK")
+
+
 def run(full=True, log=print):
     """Run the self-test; raises on any kernel/oracle disagreement.
 
     full=False is the quick subset bench.py gates on — EVERY fused path at
     small shapes: OR-combine C=64, lex2 keep-first, columnar-vs-row-major
-    OpLog, shard_map-compiled sharded_converge, the lexN RSeq kernel, and
-    the GC-aware RSeq join (round-3 verdict item 3: a Mosaic regression in
-    ANY fused path must fail bench.py before a headline exists).
+    OpLog, shard_map-compiled sharded_converge, the lexN RSeq kernel, the
+    GC-aware RSeq join, and the sharded GC-aware converge (round-3 verdict
+    item 3: a Mosaic regression in ANY fused path must fail bench.py
+    before a headline exists).
     full=True adds only the C=1024 OR-combine shape (the big-compile
     variant; the persistent compile cache makes it one-time per image).
     """
@@ -249,6 +279,7 @@ def run(full=True, log=print):
         check_sharded()
         check_lexn_rseq()
         check_gc_rseq()
+        check_sharded_gc()
         log("hw_selftest: ALL OK")
     finally:
         _log = print

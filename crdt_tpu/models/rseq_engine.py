@@ -236,17 +236,13 @@ def gc_merge_checked(a: ColumnarGc, b: ColumnarGc, interpret: bool = False):
     return merged, nu
 
 
-@partial(jax.jit, static_argnames="interpret")
-def gc_converge_checked(
-    cg: ColumnarGc, alive: jax.Array, interpret: bool = False
-):
-    """Alive-masked log-depth tree reduction to the GC-aware LUB,
-    broadcast over the alive lanes (dead lanes keep their stale state AND
-    floor) — the convergence phase of tomb_gc.gc_round on the fused
-    kernel.  Returns (ColumnarGc, max n_unique)."""
-    work = mask_dead(cg, alive)
+def _gc_lub_lane(work: ColumnarGc, interpret: bool):
+    """Log-depth lane-halving tree reduction of a (pre-masked) columnar GC
+    swarm down to ONE lane: (1-lane ColumnarGc, max n_unique over all
+    levels).  The per-shard phase of the sharded converge and the whole
+    reduction of the single-device one."""
     p = 1
-    while p < cg.lanes:
+    while p < work.lanes:
         p *= 2
     work = _pad_lanes(work, p)
     max_nu = jnp.zeros((), jnp.int32)
@@ -257,10 +253,114 @@ def gc_converge_checked(
             interpret=interpret,
         )
         max_nu = jnp.maximum(max_nu, nu.max())
-    out_col = rc._broadcast_top(cg.col, work.col, alive)
-    top_floor = jnp.broadcast_to(work.floor[:, :1], cg.floor.shape)
+    return work, max_nu
+
+
+def _finish_broadcast(cg: ColumnarGc, top: ColumnarGc, alive: jax.Array):
+    """Broadcast the reduced LUB lane (table + floor plane) over the alive
+    lanes; dead lanes keep their stale state AND floor."""
+    out_col = rc._broadcast_top(cg.col, top.col, alive)
+    top_floor = jnp.broadcast_to(top.floor[:, :1], cg.floor.shape)
     out_floor = jnp.where(alive[None, :], top_floor, cg.floor)
-    return ColumnarGc(col=out_col, floor=out_floor), max_nu
+    return ColumnarGc(col=out_col, floor=out_floor)
+
+
+@partial(jax.jit, static_argnames="interpret")
+def gc_converge_checked(
+    cg: ColumnarGc, alive: jax.Array, interpret: bool = False
+):
+    """Alive-masked log-depth tree reduction to the GC-aware LUB,
+    broadcast over the alive lanes (dead lanes keep their stale state AND
+    floor) — the convergence phase of tomb_gc.gc_round on the fused
+    kernel.  Returns (ColumnarGc, max n_unique)."""
+    work, max_nu = _gc_lub_lane(mask_dead(cg, alive), interpret)
+    return _finish_broadcast(cg, work, alive), max_nu
+
+
+def sharded_gc_converge(
+    mesh,
+    depth: int = rseq.DEPTH,
+    seq_bits: int = 20,
+    axis: str = "replica",
+    interpret: bool | None = None,
+):
+    """Multi-chip GC-AWARE columnar RSeq convergence (round-4 verdict
+    missing #1): the lane (replica) axis sharded over a device mesh with
+    the per-lane (W, R) floor planes riding the same sharding, every
+    merge the GC-aware fused join (:func:`gc_merge_checked`) — so floor
+    suppression crosses the all-gather exactly as it crosses a
+    single-device barrier.  Same three-phase program as the GC-less
+    ``rseq_columnar.sharded_converge`` it generalizes:
+
+      1. each device masks its dead lanes to the join identity (empty
+         table + floor −1) and tree-reduces its shard to a one-lane
+         GC LUB — all fused-kernel GC joins, no cross-device traffic;
+      2. one ``all_gather`` ships the P single-lane LUBs — table planes
+         AND floor plane — over ICI/DCN (the ONLY collective:
+         (3·D + 2) planes × C rows × P lanes plus W × P floor words);
+      3. each device reduces the gathered lanes to the global GC LUB and
+         broadcasts table + floor over its local alive lanes.
+
+    Build once per mesh; the returned jitted ``step(cg, alive)`` returns
+    ``(ColumnarGc, max_n_unique)`` with max_n_unique replicated (pmax),
+    the same checked-overflow contract as :func:`gc_converge_checked` —
+    this is the program ``tomb_gc.gc_round`` barriers run by default,
+    now with a multichip instantiation (dryrun program 5).
+    ``interpret`` defaults to True off TPU."""
+    from jax.sharding import PartitionSpec as P
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def local_step(keys, elem, removed, floor, alive):
+        cg = ColumnarGc(
+            col=rc.ColumnarRSeq(keys=keys, elem=elem, removed=removed,
+                                seq_bits=seq_bits),
+            floor=floor,
+        )
+        local_lub, nu_local = _gc_lub_lane(mask_dead(cg, alive), interpret)
+        gathered = jax.tree.map(
+            lambda x: jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True),
+            local_lub,
+        )
+        top, nu_global = _gc_lub_lane(gathered, interpret)
+        out = _finish_broadcast(cg, top, alive)
+        # per-device nu values differ: pmax keeps the replicated out_spec
+        # truthful (same reasoning as rseq_columnar.sharded_converge)
+        max_nu = jax.lax.pmax(jnp.maximum(nu_local, nu_global), axis)
+        return out.col.keys, out.col.elem, out.col.removed, out.floor, max_nu
+
+    shmapped = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(None, None, axis), P(None, axis), P(None, axis),
+                  P(None, axis), P(axis)),
+        out_specs=(P(None, None, axis), P(None, axis), P(None, axis),
+                   P(None, axis), P()),
+        check_vma=False,  # pallas out_shapes carry no varying-axes note
+    )
+
+    @jax.jit
+    def step(cg: ColumnarGc, alive: jax.Array):
+        if cg.col.seq_bits != seq_bits or cg.col.depth != depth:
+            raise ValueError(
+                f"state (depth={cg.col.depth}, seq_bits={cg.col.seq_bits}) "
+                f"does not match this step (depth={depth}, "
+                f"seq_bits={seq_bits})"
+            )
+        keys, elem, removed, floor, max_nu = shmapped(
+            cg.col.keys, cg.col.elem, cg.col.removed, cg.floor, alive
+        )
+        return (
+            ColumnarGc(
+                col=rc.ColumnarRSeq(keys=keys, elem=elem, removed=removed,
+                                    seq_bits=seq_bits),
+                floor=floor,
+            ),
+            max_nu,
+        )
+
+    return step
 
 
 # ---- host-level selectors (the consumers' entry points) ----------------------
