@@ -241,7 +241,9 @@ def merge_checked(a: ColumnarRSeq, b: ColumnarRSeq, interpret: bool = False):
         a = _pad_lanes(a, lanes + padded)
         b = _pad_lanes(b, lanes + padded)
     nk = a.keys.shape[0]
-    keys, (elem, removed), nu = pallas_union.sorted_union_columnar_fused_lexn(
+    # auto: one fused pallas_call inside the VMEM envelope, the
+    # capacity-striped block network beyond it (full-depth C>256)
+    keys, (elem, removed), nu = pallas_union.sorted_union_columnar_lexn_auto(
         tuple(a.keys[i] for i in range(nk)), (a.elem, a.removed),
         tuple(b.keys[i] for i in range(nk)), (b.elem, b.removed),
         out_size=a.capacity, interpret=interpret,

@@ -201,7 +201,9 @@ def gc_merge_checked(a: ColumnarGc, b: ColumnarGc, interpret: bool = False):
     # lossless union (out_size=None -> 2C): suppression happens BEFORE the
     # capacity slice, so a suppressed row never evicts a real one (the
     # generic path's union-then-slice ordering)
-    keys, (elem, removed, src), _ = pallas_union.sorted_union_columnar_fused_lexn(
+    # auto: fused single call inside the VMEM envelope, capacity-striped
+    # block network beyond it (full-depth C>256 GC joins, round-5)
+    keys, (elem, removed, src), _ = pallas_union.sorted_union_columnar_lexn_auto(
         tuple(a.col.keys[i] for i in range(nk)),
         (a.col.elem, a.col.removed, src_a),
         tuple(b.col.keys[i] for i in range(nk)),

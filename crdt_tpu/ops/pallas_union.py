@@ -428,6 +428,221 @@ def sorted_union_columnar_fused_lexn(
     )
 
 
+def _make_lexn_merge_kernel(n_keys: int, n_vals: int):
+    """Merge-ONLY lexN kernel: the bitonic compare-exchange network with no
+    duplicate punch and no compaction — outputs the exact sorted 2C-row
+    multiset.  This is the merge-split primitive of the capacity-striped
+    union (:func:`sorted_union_columnar_striped_lexn`): block-level sorting
+    networks are only textbook-correct when the primitive preserves the
+    multiset (Knuth 5.3.4: a comparator network sorts blocks under
+    merge-split iff it sorts scalars), so the dedup moves to one XLA
+    epilogue pass after the block network.  Fewer live temporaries than the
+    fused kernel (no prefix-sum/compaction stage), so it fits VMEM at
+    larger C than the fused union does."""
+
+    def kernel(*refs):
+        n_in = n_keys + n_vals
+        ins, outs = refs[: 2 * n_in], refs[2 * n_in :]
+        ka = ins[:n_keys]
+        va = ins[n_keys:n_in]
+        kbr = ins[n_in : n_in + n_keys]
+        vb = ins[n_in + n_keys :]
+
+        c = ka[0].shape[0]
+        n = 2 * c
+        planes = [
+            jnp.concatenate([a[:], b[:]], axis=0) for a, b in zip(ka, kbr)
+        ] + [jnp.concatenate([a[:], b[:]], axis=0) for a, b in zip(va, vb)]
+        planes = _merge_stages_planes(planes, n, n_keys=n_keys)
+        for ref, p in zip(outs, planes):
+            ref[:] = p
+
+    return kernel
+
+
+def lexn_merge_columnar(keys_a, vals_a, keys_b, vals_b, interpret=False):
+    """Columnar batched lexN MERGE (no dedup): lane j's output column is
+    the sorted (2C)-row merge of input columns — exact multiset, padding
+    (all-SENTINEL) rows sort to the tail.  Both operands per-lane sorted
+    ascending; B is pre-flipped in XLA (no `rev` lowering in Mosaic)."""
+    n_keys, n_vals = len(keys_a), len(vals_a)
+    c, lanes = keys_a[0].shape
+    assert c & (c - 1) == 0, f"capacity {c} must be a power of two"
+    assert lanes % LANES == 0, f"lane count {lanes} must be a multiple of {LANES}"
+    grid = (lanes // LANES,)
+    in_spec = pl.BlockSpec((c, LANES), lambda i: (0, i))
+    out_spec = pl.BlockSpec((2 * c, LANES), lambda i: (0, i))
+    n_planes = n_keys + n_vals
+    outs = pl.pallas_call(
+        _make_lexn_merge_kernel(n_keys, n_vals),
+        grid=grid,
+        in_specs=[in_spec] * (2 * n_planes),
+        out_specs=[out_spec] * n_planes,
+        out_shape=[jax.ShapeDtypeStruct((2 * c, lanes), jnp.int32)]
+        * n_planes,
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=120 << 20,
+        ),
+    )(
+        *keys_a,
+        *vals_a,
+        *(jnp.flip(k, axis=0) for k in keys_b),
+        *(jnp.flip(v, axis=0) for v in vals_b),
+    )
+    return tuple(outs[:n_keys]), tuple(outs[n_keys:])
+
+
+# The fused lexN kernel's measured VMEM envelope on v5e (PERF.md "where the
+# full-depth kernel's own ceiling is"): D=6 joins at C=256 fit; C=512
+# reports "129.60M of 128.00M".  Counting each call's planes + 1
+# (nu/compaction bookkeeping), the known-good shapes are 21x256=5376,
+# 15x512=7680, 9x1024=9216 and the measured-OOM one is 21x512=10752 — a
+# (planes+1) x C product <= 9216 keeps every known-good shape and excludes
+# the known-bad one.
+LEXN_PLANE_ROW_BUDGET = 9216
+
+
+def lexn_fits(c: int, n_planes: int) -> bool:
+    """Whether one fused lexN pallas_call at capacity ``c`` with
+    ``n_planes`` total (key+value) planes fits the v5e VMEM envelope."""
+    return c * n_planes <= LEXN_PLANE_ROW_BUDGET
+
+
+def _lexn_stripe_for(c: int, n_planes: int) -> int:
+    s = c
+    while s > 1 and not lexn_fits(s, n_planes):
+        s //= 2
+    return max(s, 8)
+
+
+def sorted_union_columnar_striped_lexn(
+    keys_a,
+    vals_a,
+    keys_b,
+    vals_b,
+    out_size: int | None = None,
+    stripe: int | None = None,
+    interpret: bool = False,
+):
+    """Capacity-STRIPED fused lexN union (round-4 verdict task 2): the same
+    contract as :func:`sorted_union_columnar_fused_lexn` at capacities
+    whose monolithic kernel would exceed VMEM (the D=6 full-depth RSeq
+    kernel OOMs at C=512; this path serves C=512..4096+ through C<=256
+    stripe calls).
+
+    Program shape:
+
+      1. each operand's C sorted rows are M = C/S stripes of S rows,
+         globally sorted across stripe boundaries (the RSeq/OpLog
+         sorted-with-tail-padding invariant gives this for free);
+      2. a block-level BITONIC MERGE network over the 2M stripes — A's
+         stripes ascending then B's reversed (block-bitonic input) — with
+         the merge-only kernel (:func:`lexn_merge_columnar`) as the
+         merge-split primitive: M·log2(2M) kernel calls of (S, L) shape,
+         every call the same compiled program.  The primitive preserves
+         the exact multiset, so block-network correctness is the scalar
+         bitonic-merge theorem verbatim (no dedup-interaction caveats);
+      3. ONE XLA epilogue over the sorted (2C, L) planes: adjacent
+         duplicate punch (each key appears at most twice — operand lanes
+         have unique keys) with OR-combine-then-keep-first, then a
+         single-key stable sort on the hole flag — kept rows are already
+         key-ordered, so the 1-key sort just sinks holes to the tail —
+         then the ``out_size`` truncation.
+
+    Returns (keys_tuple, vals_tuple, n_unique[L]); n_unique is computed
+    pre-truncation, so overflow (n_unique > out_size) stays detectable."""
+    n_keys, n_vals = len(keys_a), len(vals_a)
+    c, lanes = keys_a[0].shape
+    assert c & (c - 1) == 0, f"capacity {c} must be a power of two"
+    n_planes = n_keys + n_vals
+    s = stripe if stripe is not None else _lexn_stripe_for(c, n_planes + 1)
+    assert s & (s - 1) == 0 and c % s == 0, (
+        f"stripe {s} must be a power-of-two divisor of capacity {c}"
+    )
+    out = out_size if out_size is not None else 2 * c
+    assert out <= 2 * c, f"out_size {out} exceeds the 2C={2*c} union bound"
+
+    def rows(planes, lo, hi):
+        return tuple(p[lo:hi] for p in planes)
+
+    m = c // s
+    blocks = (
+        [(rows(keys_a, i * s, (i + 1) * s), rows(vals_a, i * s, (i + 1) * s))
+         for i in range(m)]
+        + [(rows(keys_b, i * s, (i + 1) * s),
+            rows(vals_b, i * s, (i + 1) * s))
+           for i in reversed(range(m))]
+    )
+
+    def merge_split(x, y):
+        ko, vo = lexn_merge_columnar(x[0], x[1], y[0], y[1],
+                                     interpret=interpret)
+        return (rows(ko, 0, s), rows(vo, 0, s)), (
+            rows(ko, s, 2 * s), rows(vo, s, 2 * s))
+
+    def bmerge(bs):
+        n = len(bs)
+        if n == 1:
+            return bs
+        half = n // 2
+        for i in range(half):
+            bs[i], bs[i + half] = merge_split(bs[i], bs[i + half])
+        return bmerge(bs[:half]) + bmerge(bs[half:])
+
+    blocks = bmerge(blocks)
+    keys = [jnp.concatenate([b[0][i] for b in blocks], axis=0)
+            for i in range(n_keys)]
+    vals = [jnp.concatenate([b[1][i] for b in blocks], axis=0)
+            for i in range(n_vals)]
+
+    # XLA epilogue: dup punch + 1-key compaction sort + truncation
+    dup = keys[0] != SENTINEL
+    for k in keys:
+        dup = dup & (k == _shift_down(k, 1, SENTINEL))
+    next_dup = _shift_up(dup, 1, False)
+    vals = [jnp.where(next_dup, v | _shift_up(v, 1, 0), v) for v in vals]
+    keys = [jnp.where(dup, SENTINEL, k) for k in keys]
+    vals = [jnp.where(dup, 0, v) for v in vals]
+    hole = keys[0] == SENTINEL
+    sorted_planes = jax.lax.sort(
+        [hole.astype(jnp.int32)] + keys + vals,
+        dimension=0, num_keys=1, is_stable=True,
+    )
+    nu = jnp.sum(~hole, axis=0).astype(jnp.int32)
+    return (
+        tuple(p[:out] for p in sorted_planes[1 : 1 + n_keys]),
+        tuple(p[:out] for p in sorted_planes[1 + n_keys :]),
+        nu,
+    )
+
+
+def sorted_union_columnar_lexn_auto(
+    keys_a,
+    vals_a,
+    keys_b,
+    vals_b,
+    out_size: int | None = None,
+    interpret: bool = False,
+):
+    """Dispatch between the monolithic fused lexN kernel (capacity inside
+    the VMEM envelope: one pallas_call, dedup fused) and the
+    capacity-striped path (everything larger).  Same contract as both."""
+    c = keys_a[0].shape[0]
+    n_planes = len(keys_a) + len(vals_a)
+    # +1: the fused kernel's nu/compaction bookkeeping holds an extra
+    # plane's worth of live temporaries vs the merge-only kernel
+    if lexn_fits(c, n_planes + 1):
+        return sorted_union_columnar_fused_lexn(
+            keys_a, vals_a, keys_b, vals_b,
+            out_size=out_size, interpret=interpret,
+        )
+    return sorted_union_columnar_striped_lexn(
+        keys_a, vals_a, keys_b, vals_b,
+        out_size=out_size, interpret=interpret,
+    )
+
+
 def sorted_union_columnar_fused_lex2(
     keys_a,          # (hi, lo): pair of int32[C, L], per-lane sorted asc
     vals_a,          # tuple of int32[C, L] value planes

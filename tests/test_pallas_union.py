@@ -239,3 +239,52 @@ def test_lexn_union_matches_generic(n_keys):
                 err_msg=f"val {i}",
             )
         assert int(n) == int(nu[j])
+
+
+@pytest.mark.parametrize("stripe", [8, 16, 32, 64])
+def test_striped_lexn_matches_fused(stripe):
+    """Round-5: the capacity-striped union (block-bitonic merge of sorted
+    stripes via the merge-only kernel + XLA dedup/compaction epilogue)
+    must be bit-identical to the monolithic fused kernel — including at
+    stripe == C (degenerate 2-block network) and with heavy cross-operand
+    duplication, at both lossless (2C) and capacity-truncated out sizes."""
+    rng = np.random.default_rng(60 + stripe)
+    c, lanes, n_keys, n_vals = 64, 128, 3, 2
+    ka, va = _lexn_cols(rng, c, lanes, n_keys, n_vals, or_plane=1)
+    kb, vb = _lexn_cols(rng, c, lanes, n_keys, n_vals, or_plane=1)
+    for out_size in (None, c):
+        want = pallas_union.sorted_union_columnar_fused_lexn(
+            tuple(ka), tuple(va), tuple(kb), tuple(vb),
+            out_size=out_size, interpret=True,
+        )
+        got = pallas_union.sorted_union_columnar_striped_lexn(
+            tuple(ka), tuple(va), tuple(kb), tuple(vb),
+            out_size=out_size, stripe=stripe, interpret=True,
+        )
+        for w, g in zip(want[0] + want[1] + (want[2],),
+                        got[0] + got[1] + (got[2],)):
+            np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_lexn_auto_dispatch():
+    """The auto entry point picks the monolith inside the VMEM envelope
+    and the striped path beyond it, transparently to callers."""
+    assert pallas_union.lexn_fits(256, 21)
+    assert not pallas_union.lexn_fits(512, 21)
+    # stripe selection walks down to a fitting power of two
+    assert pallas_union._lexn_stripe_for(1024, 22) == 256
+    rng = np.random.default_rng(99)
+    c, lanes = 32, 128
+    ka, va = _lexn_cols(rng, c, lanes, 3, 2, or_plane=1)
+    kb, vb = _lexn_cols(rng, c, lanes, 3, 2, or_plane=1)
+    want = pallas_union.sorted_union_columnar_fused_lexn(
+        tuple(ka), tuple(va), tuple(kb), tuple(vb),
+        out_size=c, interpret=True,
+    )
+    got = pallas_union.sorted_union_columnar_lexn_auto(
+        tuple(ka), tuple(va), tuple(kb), tuple(vb),
+        out_size=c, interpret=True,
+    )
+    for w, g in zip(want[0] + want[1] + (want[2],),
+                    got[0] + got[1] + (got[2],)):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
