@@ -55,6 +55,10 @@ class Interner:
     def __contains__(self, s: str) -> bool:
         return s in self._to_id
 
+    def items(self):
+        """(string, id) pairs in insertion (= id) order."""
+        return self._to_id.items()
+
     def __len__(self) -> int:
         return len(self._from_id)
 
