@@ -21,6 +21,8 @@ fleet deterministically, crdt_tpu.harness.crashsoak):
   POST /admin/checkpoint        crash-safe snapshot now
   POST /admin/set_pull          {"peer": url?} -> one set pull now
   POST /admin/set_barrier       one set GC barrier now (coordinator)
+  POST /admin/map_pull          {"peer": url?} -> one map pull now
+  POST /admin/map_barrier       one map reset barrier now (coordinator)
 
 Set-lattice surface (crdt_tpu.api.setnode; present only with ``admin``):
   GET  /set                     {"members": [...]}
@@ -38,6 +40,16 @@ Sequence-lattice surface (crdt_tpu.api.seqnode; present only with
   POST /seq/insert              {"elem": str, "index": int|null} -> mint
   POST /seq/remove              {"index": int} -> targeted remove
   POST /seq/collect             {"floor": {rid: seq}} -> GC fold
+
+Map-lattice surface (crdt_tpu.api.mapnode; present only with ``admin``
+or a cluster carrying map siblings) — the concrete PN-composition map
+with reset-wins epoch GC:
+  GET  /map                     {"items": {key: value}}
+  GET  /map/gossip[?vv=...]     epoch-carrying (delta) map payload
+  GET  /map/vv                  {"vv": {rid: seq}, "epochs": {key: epoch}}
+  POST /map/upd                 {"key": str, "delta": int} -> mint one op
+  POST /map/rem                 {"key": str} -> observed-remove
+  POST /map/reset               {"epochs": {key: epoch}} -> adopt reset
 
 The /condition route takes the flag as a path segment (also accepted:
 ?alive_status=) — the reference registered the route without the parameter
@@ -88,6 +100,13 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
             if admin is not None:
                 return getattr(admin, "seq_node", None)
             nodes = getattr(cluster, "seq_nodes", None)
+            return nodes[idx] if nodes else None
+
+        @property
+        def map_node(self):
+            if admin is not None:
+                return getattr(admin, "map_node", None)
+            nodes = getattr(cluster, "map_nodes", None)
             return nodes[idx] if nodes else None
 
         def _parse_vv_query(self, url):
@@ -167,6 +186,38 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     self._send(200, json.dumps({
                         "vv": {str(r): s for r, s in vv.items()},
                         "floor": {str(r): s for r, s in floor.items()},
+                    }), "application/json")
+                else:
+                    self._send(404, "not found")
+                return
+            if parts and parts[0] == "map" and self.map_node is not None:
+                mn = self.map_node
+                if url.path == "/map":
+                    items = mn.items()
+                    if items is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps({"items": items}),
+                                   "application/json")
+                elif url.path == "/map/gossip":
+                    since = self._parse_vv_query(url)
+                    if since == "bad":
+                        self._send(400, "invalid vv")
+                        return
+                    payload = mn.gossip_payload(since=since)
+                    if payload is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps(payload),
+                                   "application/json")
+                elif url.path == "/map/vv":
+                    if not mn.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    vv, epochs = mn.vv_snapshot()
+                    self._send(200, json.dumps({
+                        "vv": {str(r): s for r, s in vv.items()},
+                        "epochs": epochs,
                     }), "application/json")
                 else:
                     self._send(404, "not found")
@@ -278,6 +329,19 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         ok = admin.admin_seq_pull(body.get("peer"))
                         self._send(200, json.dumps({"pulled": bool(ok)}),
                                    "application/json")
+                    elif path == "/admin/map_pull":
+                        ok = admin.admin_map_pull(body.get("peer"))
+                        self._send(200, json.dumps({"pulled": bool(ok)}),
+                                   "application/json")
+                    elif path == "/admin/map_barrier":
+                        epochs = admin.admin_map_barrier()
+                        self._send(
+                            200,
+                            json.dumps({"epochs": {
+                                str(k): int(e) for k, e in epochs.items()
+                            }}),
+                            "application/json",
+                        )
                     elif path == "/admin/seq_barrier":
                         floor = admin.admin_seq_barrier()
                         self._send(
@@ -394,6 +458,57 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         self._send(400, "invalid floor")
                         return
                     qn.collect(floor)
+                    self._send(200, "OK")
+                else:
+                    self._send(404, "not found")
+                return
+            if path.startswith("/map/") and self.map_node is not None:
+                mn = self.map_node
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    assert isinstance(body, dict)
+                except Exception:
+                    self._send(400, "invalid body")
+                    return
+                if path == "/map/upd":
+                    try:
+                        delta = int(body.get("delta"))
+                    except (TypeError, ValueError):
+                        self._send(400, "invalid delta")
+                        return
+                    ident = mn.upd(str(body.get("key", "")), delta)
+                    if ident is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps(
+                            {"rid": ident[0], "seq": ident[1]}
+                        ), "application/json")
+                elif path == "/map/rem":
+                    if not mn.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    ident = mn.rem(str(body.get("key", "")))
+                    op = mn.op_record(ident) if ident else None
+                    self._send(200, json.dumps({
+                        "removed": ident is not None,
+                        "rid": ident[0] if ident else None,
+                        "seq": ident[1] if ident else None,
+                        "obs": (op or {}).get("obs", {}),
+                    }), "application/json")
+                elif path == "/map/reset":
+                    if not mn.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    try:
+                        epochs = {
+                            str(k): int(e)
+                            for k, e in (body.get("epochs") or {}).items()
+                        }
+                    except Exception:
+                        self._send(400, "invalid epochs")
+                        return
+                    mn.adopt_epochs(epochs)
                     self._send(200, "OK")
                 else:
                     self._send(404, "not found")

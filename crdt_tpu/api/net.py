@@ -49,6 +49,7 @@ class RemotePeer:
         # round and keeps the outage metrics truthful.
         self.serves_set: Optional[bool] = None
         self.serves_seq: Optional[bool] = None  # same, for /seq/gossip
+        self.serves_map: Optional[bool] = None  # same, for /map/gossip
 
     def _get(self, path: str) -> Optional[bytes]:
         try:
@@ -224,6 +225,33 @@ class RemotePeer:
             {"floor": {str(r): s for r, s in floor.items()}},
         )
 
+    # ---- map-lattice surface (crdt_tpu.api.mapnode) ----
+
+    def map_gossip_payload(
+        self, since: Optional[Dict[int, int]] = None
+    ) -> Optional[Dict[str, Any]]:
+        """GET /map/gossip (epoch-carrying delta; always valid)."""
+        return self._probe_get(
+            self._vv_query("/map/gossip", since), "serves_map"
+        )
+
+    def map_vv(self):
+        """GET /map/vv → (vv, epochs) or None when down/unreachable."""
+        d = self._parse(self._get("/map/vv"))
+        if d is None:
+            return None
+        return (
+            {int(r): int(s) for r, s in (d.get("vv") or {}).items()},
+            {str(k): int(e) for k, e in (d.get("epochs") or {}).items()},
+        )
+
+    def map_reset(self, epochs: Dict[str, int]) -> bool:
+        """POST /map/reset: adopt barrier-minted epochs."""
+        return self._post(
+            "/map/reset",
+            {"epochs": {str(k): int(e) for k, e in epochs.items()}},
+        )
+
 
 def network_compact(node: ReplicaNode, peers: List[RemotePeer]) -> Dict[int, int]:
     """One cross-daemon compaction barrier (the network analogue of
@@ -283,10 +311,12 @@ class NetworkAgent:
         coordinator: bool = False,
         set_node=None,
         seq_node=None,
+        map_node=None,
     ):
         self.node = node
         self.set_node = set_node  # optional SetNode sibling: pulled together
         self.seq_node = seq_node  # optional SeqNode sibling: pulled together
+        self.map_node = map_node  # optional MapNode sibling: pulled together
         self.peers = [RemotePeer(u) for u in peer_urls]
         self.config = config or ClusterConfig()
         self.metrics = metrics or node.metrics
@@ -319,6 +349,7 @@ class NetworkAgent:
         )
         self.set_pull(peer)
         self.seq_pull(peer)
+        self.map_pull(peer)
         return merged
 
     def set_pull(self, peer: RemotePeer) -> bool:
@@ -434,6 +465,62 @@ class NetworkAgent:
         self.metrics.inc("seq_collections_scheduled")
         return floor
 
+    def map_pull(self, peer: RemotePeer) -> bool:
+        """One map-lattice pull from ``peer`` (no-op without a map node)
+        — the map sibling of set_pull; epoch-carrying deltas are always
+        valid, so there is no full-payload mode to negotiate."""
+        mn = self.map_node
+        if mn is None or not mn.alive:
+            return False
+        payload = peer.map_gossip_payload(since=mn.version_vector())
+        if payload is None:
+            self.metrics.inc(
+                "map_gossip_unsupported" if peer.serves_map is False
+                else "map_gossip_skipped"
+            )
+            return False
+        fresh = mn.receive(payload)
+        self.metrics.inc("map_gossip_rounds" if fresh else "map_gossip_noop")
+        return fresh > 0
+
+    def map_reset_once(self) -> dict:
+        """One cross-daemon map RESET barrier (coordinator only): the
+        full-fleet rule of ormap_gc.reset_barrier over the network
+        (mapnode module docstring).  Protocol: (1) every member must be
+        reachable, else skip; (2) pull every member's contributions into
+        the coordinator's node; (3) verify the coordinator's vv dominates
+        every member's (their contributions ARE folded); (4) mint the
+        reset locally and push the new epochs — a member that misses the
+        push adopts them from any peer's next payload."""
+        from crdt_tpu.api import mapnode as mapnode_mod
+
+        mn = self.map_node
+        if mn is None or not mn.alive:
+            self.metrics.inc("map_reset_skipped")
+            return {}
+        with ThreadPoolExecutor(max_workers=max(len(self.peers), 1)) as pool:
+            # full-fleet reachability + fold everyone's contributions
+            for peer, got in zip(self.peers,
+                                 pool.map(lambda p: p.map_vv(), self.peers)):
+                if got is None:
+                    self.metrics.inc("map_reset_skipped")
+                    return {}
+                self.map_pull(peer)
+            vvs = list(pool.map(lambda p: p.map_vv(), self.peers))
+            if not mapnode_mod.map_barrier_ready(
+                mn, [None if v is None else v[0] for v in vvs]
+            ):
+                # a member died or minted mid-barrier: try next round
+                self.metrics.inc("map_reset_skipped")
+                return {}
+            epochs = mn.mint_reset()
+            if not epochs:
+                self.metrics.inc("map_reset_noop")
+                return {}
+            list(pool.map(lambda p: p.map_reset(epochs), self.peers))
+        self.metrics.inc("map_resets_scheduled")
+        return epochs
+
     def _loop(self) -> None:
         period = self.config.gossip_period_ms / 1000.0
         rounds = 0
@@ -453,6 +540,9 @@ class NetworkAgent:
                 qce = self.config.seq_collect_every
                 if self.coordinator and qce and rounds % qce == 0:
                     self.seq_collect_once()
+                mre = self.config.map_reset_every
+                if self.coordinator and mre and rounds % mre == 0:
+                    self.map_reset_once()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.metrics.inc("net_gossip_loop_errors")
                 self.errors.append(e)
@@ -486,6 +576,7 @@ class NodeHost:
         checkpoint_every_s: float = 0,
     ):
         from crdt_tpu.api.http_shim import _make_handler
+        from crdt_tpu.api.mapnode import MapNode
         from crdt_tpu.api.seqnode import SeqNode
         from crdt_tpu.api.setnode import SetNode
 
@@ -512,6 +603,9 @@ class NodeHost:
         # the sequence-lattice sibling (crdt_tpu.api.seqnode): same wire
         # rid, disjoint namespace, gossiped and checkpointed alongside
         self.seq_node = SeqNode(rid=rid)
+        # the map-lattice sibling (crdt_tpu.api.mapnode): the concrete
+        # PN-composition map with reset-wins epoch GC, same deployment
+        self.map_node = MapNode(rid=rid)
         # crash recovery: restore the newest complete snapshot (if any)
         # BEFORE serving.  The caller is responsible for minting rid via
         # checkpoint.bump_incarnation when restores can land in a live
@@ -526,12 +620,13 @@ class NodeHost:
             # flag as fault-injection state, not durable data)
             self.restored = ckpt.load_latest_node(
                 checkpoint_dir, self.node, set_node=self.set_node,
-                seq_node=self.seq_node,
+                seq_node=self.seq_node, map_node=self.map_node,
             )
         self.nodes = [self.node]  # duck-types as a cluster for the handler
         self.agent = NetworkAgent(
             self.node, peers, self.config, coordinator=coordinator,
             set_node=self.set_node, seq_node=self.seq_node,
+            map_node=self.map_node,
         )
         self._server = ThreadingHTTPServer(
             (host, port), _make_handler(self, 0, admin=self)
@@ -603,7 +698,7 @@ class NodeHost:
 
         return ckpt.save_node_atomic(
             self.checkpoint_dir, self.node, set_node=self.set_node,
-            seq_node=self.seq_node,
+            seq_node=self.seq_node, map_node=self.map_node,
         )
 
     def admin_pull(self, peer_url: Optional[str] = None) -> bool:
@@ -655,3 +750,18 @@ class NodeHost:
     def admin_seq_barrier(self) -> dict:
         """One sequence GC barrier, now (coordinator only)."""
         return self.agent.seq_collect_once()
+
+    def admin_map_pull(self, peer_url: Optional[str] = None) -> bool:
+        """One map-lattice pull, now, from ``peer_url`` (or a random
+        configured peer)."""
+        if peer_url is None:
+            if not self.agent.peers:
+                return False
+            peer = self.agent._rng.choice(self.agent.peers)
+        else:
+            peer = RemotePeer(peer_url)
+        return self.agent.map_pull(peer)
+
+    def admin_map_barrier(self) -> dict:
+        """One map reset barrier, now (coordinator only)."""
+        return self.agent.map_reset_once()

@@ -43,19 +43,24 @@ def _interner_load(strings: list, interner) -> None:
         interner.intern(s)
 
 
-def save_node(path: str, node, set_node=None, seq_node=None) -> None:
+def save_node(path: str, node, set_node=None, seq_node=None,
+              map_node=None) -> None:
     """Snapshot a ReplicaNode: op-tensor columns + interner tables + the
     raw command map (the gossip-serving source of truth).  ``set_node``
     (a crdt_tpu.api.setnode.SetNode) adds the daemon's set-lattice section
     — its host op records + GC floor, from which the device table is
     rebuilt on restore; ``seq_node`` (crdt_tpu.api.seqnode.SeqNode) adds
-    the sequence-lattice section the same way."""
+    the sequence-lattice section the same way; ``map_node``
+    (crdt_tpu.api.mapnode.MapNode) adds the map-lattice section (op
+    records + reset epochs)."""
     p = pathlib.Path(path)
     p.mkdir(parents=True, exist_ok=True)
     if set_node is not None:
         (p / "set.json").write_text(json.dumps(set_node.to_snapshot()))
     if seq_node is not None:
         (p / "seq.json").write_text(json.dumps(seq_node.to_snapshot()))
+    if map_node is not None:
+        (p / "map.json").write_text(json.dumps(map_node.to_snapshot()))
     cols = {
         name: np.asarray(getattr(node.log, name))
         for name in ("ts", "rid", "seq", "key", "val", "payload", "is_num")
@@ -79,7 +84,7 @@ def save_node(path: str, node, set_node=None, seq_node=None) -> None:
 
 
 def restore_node(path: str, node, allow_rid_change: bool = False,
-                 set_node=None, seq_node=None) -> None:
+                 set_node=None, seq_node=None, map_node=None) -> None:
     """Restore a snapshot into a freshly-constructed ReplicaNode.
 
     ``allow_rid_change=True`` is the boot-incarnation path (see module
@@ -124,6 +129,8 @@ def restore_node(path: str, node, allow_rid_change: bool = False,
         set_node.from_snapshot(json.loads((p / "set.json").read_text()))
     if seq_node is not None and (p / "seq.json").exists():
         seq_node.from_snapshot(json.loads((p / "seq.json").read_text()))
+    if map_node is not None and (p / "map.json").exists():
+        map_node.from_snapshot(json.loads((p / "map.json").read_text()))
 
 
 # ---- crash-safe versioned snapshots + boot incarnations ---------------------
@@ -139,7 +146,8 @@ def _replace_file(path: pathlib.Path, data: str) -> None:
     os.replace(tmp, path)
 
 
-def save_node_atomic(root: str, node, set_node=None, seq_node=None) -> str:
+def save_node_atomic(root: str, node, set_node=None, seq_node=None,
+                     map_node=None) -> str:
     """Snapshot ``node`` into a fresh versioned directory under ``root``
     and atomically repoint LATEST at it — a SIGKILL at ANY instant leaves
     either the previous complete snapshot or the new complete snapshot as
@@ -161,7 +169,8 @@ def save_node_atomic(root: str, node, set_node=None, seq_node=None) -> str:
     staging = rootp / f".staging-{os.getpid()}-{n}"
     shutil.rmtree(staging, ignore_errors=True)  # orphan from a past crash
     with node._lock:
-        save_node(str(staging), node, set_node=set_node, seq_node=seq_node)
+        save_node(str(staging), node, set_node=set_node, seq_node=seq_node,
+                  map_node=map_node)
     final = rootp / f"snap-{n:08d}"
     os.rename(staging, final)  # same fs: atomic
     _replace_file(latest, final.name)
@@ -175,7 +184,7 @@ def save_node_atomic(root: str, node, set_node=None, seq_node=None) -> str:
 
 
 def load_latest_node(root: str, node, allow_rid_change: bool = True,
-                     set_node=None, seq_node=None) -> bool:
+                     set_node=None, seq_node=None, map_node=None) -> bool:
     """Restore the newest complete snapshot under ``root`` into ``node``;
     False when none exists (fresh boot)."""
     rootp = pathlib.Path(root)
@@ -184,7 +193,7 @@ def load_latest_node(root: str, node, allow_rid_change: bool = True,
         return False
     snap = rootp / latest.read_text().strip()
     restore_node(str(snap), node, allow_rid_change=allow_rid_change,
-                 set_node=set_node, seq_node=seq_node)
+                 set_node=set_node, seq_node=seq_node, map_node=map_node)
     return True
 
 

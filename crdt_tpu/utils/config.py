@@ -59,6 +59,10 @@ class ClusterConfig:
     # same, for the sequence lattice (crdt_tpu.api.seqnode): a seq GC
     # barrier every N gossip rounds (0 = only explicit /admin/seq_barrier)
     seq_collect_every: int = 0
+    # map-lattice reset barrier (crdt_tpu.api.mapnode): every N gossip
+    # rounds the coordinator attempts a full-fleet reset of stably-removed
+    # keys (0 = only explicit /admin/map_barrier)
+    map_reset_every: int = 0
     # emit full-dump gossip with the reference's bare integer-ms keys so an
     # ORIGINAL Go peer can pull from this fleet without killing its gossip
     # loop (quirk §0.1.8).  Lossy by the reference's own rule: same-ms ops
