@@ -51,6 +51,7 @@ def run_demo(args) -> int:
         delta_gossip=not args.full_gossip,
         set_collect_every=args.set_collect_every if args.with_sets else 0,
         seq_collect_every=args.seq_collect_every if args.with_seqs else 0,
+        map_reset_every=args.map_reset_every if args.with_maps else 0,
     )
     cluster = LocalCluster(cfg)
     http = HttpCluster(cluster)
@@ -67,6 +68,7 @@ def run_demo(args) -> int:
     last_report = time.time()
     set_ops = 0
     seq_ops = 0
+    map_ops = 0
     try:
         while t_end is None or time.time() < t_end:
             writes += wg.drive_http(urls, 1)
@@ -74,6 +76,8 @@ def run_demo(args) -> int:
                 set_ops += wg.drive_set_http(urls, 1)
             if args.with_seqs:
                 seq_ops += wg.drive_seq_http(urls, 1)
+            if args.with_maps:
+                map_ops += wg.drive_map_http(urls, 1)
             if time.time() - last_report >= args.report_every:
                 converged = cluster.converged()
                 alive = [s for s in cluster.states() if s is not None]
@@ -102,6 +106,14 @@ def run_demo(args) -> int:
                         f"seq_collections="
                         f"{m.get('seq_collections', 0)}"
                     )
+                if args.with_maps:
+                    mitems = cluster.map_nodes[0].items() or {}
+                    line += (
+                        f" | map_ops={map_ops} keys={len(mitems)} "
+                        f"map_converged={cluster.map_converged()} "
+                        f"map_resets="
+                        f"{m.get('map_resets_scheduled', 0)}"
+                    )
                 print(line)
                 last_report = time.time()
             time.sleep(cfg.write_period_ms / 1000.0)
@@ -116,13 +128,15 @@ def run_demo(args) -> int:
     ok = cluster.converged()
     set_ok = cluster.set_converged() if args.with_sets else True
     seq_ok = cluster.seq_converged() if args.with_seqs else True
+    map_ok = cluster.map_converged() if args.with_maps else True
     for _ in range(64 * len(cluster.nodes)):
-        if ok and set_ok and seq_ok:
+        if ok and set_ok and seq_ok and map_ok:
             break
         cluster.tick()
         ok = cluster.converged()
         set_ok = cluster.set_converged() if args.with_sets else True
         seq_ok = cluster.seq_converged() if args.with_seqs else True
+        map_ok = cluster.map_converged() if args.with_maps else True
     alive = [s for s in cluster.states() if s is not None]
     line = (f"final: writes={writes} converged={ok} "
             f"state_keys={len(alive[0]) if alive else 0}")
@@ -134,10 +148,14 @@ def run_demo(args) -> int:
         items = cluster.seq_nodes[0].items() or []
         line += (f" | seq_ops={seq_ops} seq_converged={seq_ok} "
                  f"len={len(items)}")
+    if args.with_maps:
+        mitems = cluster.map_nodes[0].items() or {}
+        line += (f" | map_ops={map_ops} map_converged={map_ok} "
+                 f"keys={len(mitems)}")
     print(line)
     if args.dump_state and alive:
         print(json.dumps(alive[0], sort_keys=True))
-    return 0 if ok and set_ok and seq_ok else 1
+    return 0 if ok and set_ok and seq_ok and map_ok else 1
 
 
 def run_daemon(args) -> int:
@@ -168,6 +186,11 @@ def run_daemon(args) -> int:
               "(exactly one daemon schedules seq GC barriers)",
               file=sys.stderr)
         return 2
+    if args.map_reset_every and not args.coordinator:
+        print("--map-reset-every in --daemon mode requires --coordinator "
+              "(exactly one daemon schedules map reset barriers)",
+              file=sys.stderr)
+        return 2
     cfg = ClusterConfig(
         gossip_period_ms=args.gossip_ms,
         compact_every=args.compact_every,
@@ -175,6 +198,7 @@ def run_daemon(args) -> int:
         go_compat_gossip=args.go_compat_gossip,
         set_collect_every=args.set_collect_every,
         seq_collect_every=args.seq_collect_every,
+        map_reset_every=args.map_reset_every,
     )
     peers = [u for u in (args.peers or "").split(",") if u]
     rid = args.rid
@@ -279,6 +303,16 @@ def main(argv=None) -> int:
                     help="run a sequence GC barrier every N gossip rounds "
                          "(demo: replica 0's loop, needs --with-seqs; "
                          "daemon: coordinator only)")
+    ap.add_argument("--with-maps", action="store_true",
+                    help="demo: drive the map lattice alongside the KV "
+                         "workload (/map/upd + /map/rem — the concrete "
+                         "PN-composition map with reset-wins epoch GC) "
+                         "and report map convergence")
+    ap.add_argument("--map-reset-every", type=int, default=0,
+                    help="run a full-fleet map reset barrier every N "
+                         "gossip rounds (demo: needs --with-maps; daemon: "
+                         "coordinator only; 0 = only explicit "
+                         "POST /admin/map_barrier)")
     ap.add_argument("--go-compat-gossip", action="store_true",
                     help="daemon: emit full-dump gossip with bare integer-ms "
                          "keys so an ORIGINAL Go peer can pull from this "

@@ -102,6 +102,41 @@ class WorkloadGenerator:
                 pass  # dead replica: skipped
         return accepted
 
+    # ---- map-lattice drive (demo: /map/upd + /map/rem) ----
+
+    def drive_map_http(self, urls: List[str], n_ops: int,
+                       timeout: float = 5.0) -> int:
+        """75% signed-delta updates on a small hot key set (the
+        reference's per-key PN workload shape, main.go:275-282), 25%
+        observed-removes — removals plus a reset-barrier cadence keep the
+        map's state bounded (ormap_gc)."""
+        accepted = 0
+        c = self.config
+        for _ in range(n_ops):
+            target = self._rng.randrange(c.n_replicas)
+            key = "m" + c.key_alphabet[self._rng.randrange(
+                min(8, len(c.key_alphabet))
+            )]
+            if self._rng.random() < 0.75:
+                body = {"key": key,
+                        "delta": self._rng.randrange(10) - 2 * 10}
+                path = "/map/upd"
+            else:
+                body = {"key": key}
+                path = "/map/rem"
+            req = urllib.request.Request(
+                urls[target % len(urls)] + path,
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as res:
+                    accepted += res.status == 200
+            except Exception:
+                pass  # dead replica: skipped
+        return accepted
+
     # ---- HTTP drive (works against the Go reference too) ----
 
     def drive_http(self, urls: List[str], n_writes: int, timeout: float = 5.0) -> int:
