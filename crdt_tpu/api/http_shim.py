@@ -218,6 +218,7 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     self._send(200, json.dumps({
                         "vv": {str(r): s for r, s in vv.items()},
                         "epochs": epochs,
+                        "records": mn.n_records(),
                     }), "application/json")
                 else:
                     self._send(404, "not found")
@@ -334,12 +335,16 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         self._send(200, json.dumps({"pulled": bool(ok)}),
                                    "application/json")
                     elif path == "/admin/map_barrier":
-                        epochs = admin.admin_map_barrier()
+                        out = admin.admin_map_barrier()
                         self._send(
                             200,
-                            json.dumps({"epochs": {
-                                str(k): int(e) for k, e in epochs.items()
-                            }}),
+                            json.dumps({
+                                "epochs": {
+                                    str(k): int(e)
+                                    for k, e in out["epochs"].items()
+                                },
+                                "status": out["status"],
+                            }),
                             "application/json",
                         )
                     elif path == "/admin/seq_barrier":
@@ -481,8 +486,10 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     if ident is None:
                         self._send(502, "Unreachable")
                     else:
+                        op = mn.op_record(ident) or {}
                         self._send(200, json.dumps(
-                            {"rid": ident[0], "seq": ident[1]}
+                            {"rid": ident[0], "seq": ident[1],
+                             "e": int(op.get("e", 0))}
                         ), "application/json")
                 elif path == "/map/rem":
                     if not mn.alive:
@@ -495,6 +502,7 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         "rid": ident[0] if ident else None,
                         "seq": ident[1] if ident else None,
                         "obs": (op or {}).get("obs", {}),
+                        "e": int((op or {}).get("e", 0)),
                     }), "application/json")
                 elif path == "/map/reset":
                     if not mn.alive:

@@ -188,6 +188,13 @@ class MapNode:
         with self._lock:
             return self._epochs_locked()
 
+    def n_records(self) -> int:
+        """Retained host op-record count — the map's state-growth gauge
+        (the churn soak samples it to measure growth between successful
+        reset barriers)."""
+        with self._lock:
+            return len(self._ops)
+
     def ping(self) -> bool:
         return self.alive
 

@@ -483,7 +483,7 @@ class NetworkAgent:
         self.metrics.inc("map_gossip_rounds" if fresh else "map_gossip_noop")
         return fresh > 0
 
-    def map_reset_once(self) -> dict:
+    def map_reset_once(self):
         """One cross-daemon map RESET barrier (coordinator only): the
         full-fleet rule of ormap_gc.reset_barrier over the network
         (mapnode module docstring).  Protocol: (1) every member must be
@@ -491,20 +491,25 @@ class NetworkAgent:
         the coordinator's node; (3) verify the coordinator's vv dominates
         every member's (their contributions ARE folded); (4) mint the
         reset locally and push the new epochs — a member that misses the
-        push adopts them from any peer's next payload."""
+        push adopts them from any peer's next payload.
+
+        Returns ``(epochs, status)``; status is "reset" (epochs minted),
+        "noop" (fleet converged, nothing stably removed), or "skipped"
+        (full-fleet rule blocked) — the churn soak measures the barrier
+        fire-rate from it."""
         from crdt_tpu.api import mapnode as mapnode_mod
 
         mn = self.map_node
         if mn is None or not mn.alive:
             self.metrics.inc("map_reset_skipped")
-            return {}
+            return {}, "skipped"
         with ThreadPoolExecutor(max_workers=max(len(self.peers), 1)) as pool:
             # full-fleet reachability + fold everyone's contributions
             for peer, got in zip(self.peers,
                                  pool.map(lambda p: p.map_vv(), self.peers)):
                 if got is None:
                     self.metrics.inc("map_reset_skipped")
-                    return {}
+                    return {}, "skipped"
                 self.map_pull(peer)
             vvs = list(pool.map(lambda p: p.map_vv(), self.peers))
             if not mapnode_mod.map_barrier_ready(
@@ -512,14 +517,14 @@ class NetworkAgent:
             ):
                 # a member died or minted mid-barrier: try next round
                 self.metrics.inc("map_reset_skipped")
-                return {}
+                return {}, "skipped"
             epochs = mn.mint_reset()
             if not epochs:
                 self.metrics.inc("map_reset_noop")
-                return {}
+                return {}, "noop"
             list(pool.map(lambda p: p.map_reset(epochs), self.peers))
         self.metrics.inc("map_resets_scheduled")
-        return epochs
+        return epochs, "reset"
 
     def _loop(self) -> None:
         period = self.config.gossip_period_ms / 1000.0
@@ -763,5 +768,7 @@ class NodeHost:
         return self.agent.map_pull(peer)
 
     def admin_map_barrier(self) -> dict:
-        """One map reset barrier, now (coordinator only)."""
-        return self.agent.map_reset_once()
+        """One map reset barrier, now (coordinator only); returns
+        {"epochs": ..., "status": "reset"|"noop"|"skipped"}."""
+        epochs, status = self.agent.map_reset_once()
+        return {"epochs": epochs, "status": status}

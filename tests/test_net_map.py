@@ -58,8 +58,8 @@ def test_map_reset_barrier_over_the_network(trio):
     b.map_node.rem("gone")
     _converge(trio)
     # coordinator (a) schedules the barrier through the agent machinery
-    epochs = a.agent.map_reset_once()
-    assert epochs == {"gone": 1}
+    epochs, status = a.agent.map_reset_once()
+    assert epochs == {"gone": 1} and status == "reset"
     # the POST push landed everywhere (no gossip needed)
     for h in trio:
         assert h.map_node.epochs() == {"gone": 1}
@@ -76,9 +76,9 @@ def test_map_barrier_skipped_when_member_unreachable(trio):
     b.map_node.rem("k")
     _converge(trio)
     c.map_node.set_alive(False)
-    assert a.agent.map_reset_once() == {}
+    assert a.agent.map_reset_once() == ({}, "skipped")
     c.map_node.set_alive(True)
-    assert a.agent.map_reset_once() == {"k": 1}
+    assert a.agent.map_reset_once() == ({"k": 1}, "reset")
 
 
 def test_stale_snapshot_restore_races_reset_barrier(tmp_path, trio):
@@ -98,8 +98,8 @@ def test_stale_snapshot_restore_races_reset_barrier(tmp_path, trio):
                           seq_node=c.seq_node, map_node=c.map_node)
     b.map_node.rem("k")
     _converge(trio)
-    epochs = a.agent.map_reset_once()
-    assert epochs == {"k": 1}
+    epochs, status = a.agent.map_reset_once()
+    assert epochs == {"k": 1} and status == "reset"
     # c crashes; a fresh host restores the STALE snapshot (same rid —
     # the single-writer-window restore; incarnation-rid restores are the
     # crashsoak's department)
@@ -151,4 +151,5 @@ def test_admin_map_routes(trio):
         headers={"Content-Type": "application/json"}, method="POST",
     )
     with urllib.request.urlopen(req) as res:
-        assert json.loads(res.read())["epochs"] == {}
+        out = json.loads(res.read())
+    assert out["epochs"] == {} and out["status"] == "noop"
