@@ -463,6 +463,20 @@ def bench_gossip_allreduce(results, tiny):
 
 # ---- driver -----------------------------------------------------------------
 
+def bench_rseq_striped(results, tiny):
+    """Full-depth RSeq ABOVE the monolithic kernel's VMEM ceiling: the
+    capacity-striped engine at C=512 and C=1024 x D=6 (round-5; see
+    benches/bench_rseq_striped.py for the standalone driver with the
+    compiled-vs-oracle verify).  These capacities had NO viable compiled
+    program before the striped path (kernel OOM; generic sort DNF)."""
+    from benches import bench_rseq_striped as brs
+
+    for c in (64,) if tiny else (512, 1024):
+        for line in brs.bench_config(c, lanes=128 if tiny else 256):
+            print(json.dumps(line), flush=True)
+            results.append(line)
+
+
 ALL = {
     "gcounter_pair": bench_gcounter_pair,
     "pncounter_vmap": bench_pncounter_vmap,
@@ -471,6 +485,7 @@ ALL = {
     "orset_union": bench_orset_union,
     "orset_sweep": bench_orset_sweep,
     "orset_1m": bench_orset_1m,
+    "rseq_striped": bench_rseq_striped,
     "gossip_allreduce": bench_gossip_allreduce,
 }
 

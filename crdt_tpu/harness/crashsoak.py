@@ -214,6 +214,15 @@ class CrashReport:
     seq_barriers_empty: int = 0
     seq_ops_lost: int = 0
     final_len: int = 0
+    map_upds: int = 0
+    map_rems: int = 0
+    map_pulls: int = 0
+    map_barriers: int = 0         # fired: epochs minted
+    map_barriers_noop: int = 0    # fired: nothing stably removed
+    map_barriers_skipped: int = 0 # full-fleet rule blocked (churn)
+    map_ops_lost: int = 0
+    map_peak_records: int = 0     # peak retained records between resets
+    final_map_keys: int = 0
 
     def __str__(self) -> str:
         return (
@@ -231,7 +240,12 @@ class CrashReport:
             f"{self.seq_inserts}+{self.seq_removes} ops, {self.seq_pulls} "
             f"pulls, {self.seq_barriers} GC barriers "
             f"(+{self.seq_barriers_empty} empty), {self.seq_ops_lost} "
-            f"crash-lost, len {self.final_len}"
+            f"crash-lost, len {self.final_len}; map: {self.map_upds}+"
+            f"{self.map_rems} ops, {self.map_pulls} pulls, "
+            f"{self.map_barriers} resets (+{self.map_barriers_noop} noop, "
+            f"{self.map_barriers_skipped} skipped), {self.map_ops_lost} "
+            f"crash-lost, peak {self.map_peak_records} records, "
+            f"{self.final_map_keys} keys"
         )
 
 
@@ -279,6 +293,14 @@ class CrashSoakRunner:
         self.seq_accepted_per_boot: Dict[int, int] = {}
         self.seq_ckpt_watermark: Dict[int, int] = {}
         self.last_seq_floor: Dict[int, int] = {}      # Q2 monotonicity bar
+        # map-lattice oracle: upds (rid, seq, key, delta, epoch_at_mint),
+        # rems (rid, seq, key, {writer: observed_tok}, epoch_at_mint)
+        self.map_upds: List[Tuple[int, int, str, int, int]] = []
+        self.map_rems: List[Tuple[int, int, str, Dict[int, int], int]] = []
+        self.map_accepted_per_boot: Dict[int, int] = {}
+        self.map_ckpt_watermark: Dict[int, int] = {}
+        self.last_map_epochs: Dict[str, int] = {}     # M2 monotonicity bar
+        self.map_keys = [f"m{i}" for i in range(max(3, n_keys // 2))]
         self.report = CrashReport()
 
     # ---- schedule actions ----
@@ -442,6 +464,87 @@ class CrashSoakRunner:
         else:
             self.report.seq_barriers_empty += 1
 
+    # ---- map-lattice actions (M-invariants) ----
+
+    def _map_write(self) -> None:
+        r = self.report
+        d = self.rng.choice(self.daemons)
+        if not d.running:
+            return
+        rid = d.wire_rid
+        key = self.rng.choice(self.map_keys)
+        if self.rng.random() < 0.7:
+            delta = self.rng.randint(-20, 20)
+            code, body = _http(d.url + "/map/upd", "POST",
+                               {"key": key, "delta": delta})
+            if code == 200:
+                got = json.loads(body)
+                seq = self.map_accepted_per_boot.get(rid, 0)
+                assert (got["rid"], got["seq"]) == (rid, seq), (
+                    f"M1: daemon minted {got['rid']}:{got['seq']}, oracle "
+                    f"expected {rid}:{seq}"
+                )
+                self.map_accepted_per_boot[rid] = seq + 1
+                self.map_upds.append((rid, seq, key, delta, int(got["e"])))
+                r.map_upds += 1
+        else:
+            code, body = _http(d.url + "/map/rem", "POST", {"key": key})
+            if code == 200:
+                got = json.loads(body)
+                if got["removed"]:
+                    seq = self.map_accepted_per_boot.get(rid, 0)
+                    assert (got["rid"], got["seq"]) == (rid, seq), (
+                        f"M1: daemon minted {got['rid']}:{got['seq']} for a "
+                        f"remove, oracle expected {rid}:{seq}"
+                    )
+                    self.map_accepted_per_boot[rid] = seq + 1
+                    self.map_rems.append((
+                        rid, seq, key,
+                        {int(w): int(t) for w, t in got["obs"].items()},
+                        int(got["e"]),
+                    ))
+                    r.map_rems += 1
+
+    def _map_pull(self) -> None:
+        up = self._running()
+        if not up:
+            return
+        d = self.rng.choice(up)
+        peer = self.rng.choice(d.peer_urls)
+        code, body = _http(d.url + "/admin/map_pull", "POST", {"peer": peer})
+        assert code == 200, f"M3: map pull 500d: {body!r}"
+        self.report.map_pulls += json.loads(body)["pulled"]
+
+    def _map_barrier(self) -> None:
+        d = self.daemons[0]  # the fleet's single coordinator
+        if not d.running:
+            return
+        # churn gauge: peak retained-record count across reachable daemons
+        for dm in self._running():
+            code, body = _http(dm.url + "/map/vv")
+            if code == 200:
+                self.report.map_peak_records = max(
+                    self.report.map_peak_records,
+                    int(json.loads(body).get("records", 0)),
+                )
+        code, body = _http(d.url + "/admin/map_barrier", "POST", {})
+        assert code == 200, f"M3: map barrier 500d: {body!r}"
+        got = json.loads(body)
+        if got["status"] == "reset":
+            epochs = {str(k): int(e) for k, e in got["epochs"].items()}
+            # M2 bookkeeping: successful resets advance epochs monotonically
+            for k, e in self.last_map_epochs.items():
+                assert epochs.get(k, 0) >= e or k not in epochs, (
+                    f"M2: epoch regressed at key {k}: {epochs} < "
+                    f"{self.last_map_epochs}"
+                )
+            self.last_map_epochs.update(epochs)
+            self.report.map_barriers += 1
+        elif got["status"] == "noop":
+            self.report.map_barriers_noop += 1
+        else:
+            self.report.map_barriers_skipped += 1
+
     def _pull(self) -> None:
         up = self._running()
         if not up:
@@ -477,6 +580,7 @@ class CrashSoakRunner:
         self.ckpt_watermark[rid] = self.accepted_per_boot.get(rid, 0)
         self.set_ckpt_watermark[rid] = self.set_accepted_per_boot.get(rid, 0)
         self.seq_ckpt_watermark[rid] = self.seq_accepted_per_boot.get(rid, 0)
+        self.map_ckpt_watermark[rid] = self.map_accepted_per_boot.get(rid, 0)
         self.report.checkpoints += 1
 
     def _soft_toggle(self) -> None:
@@ -508,29 +612,35 @@ class CrashSoakRunner:
 
     def step(self) -> None:
         x = self.rng.random()
-        if x < 0.18:
+        if x < 0.16:
             self._write()
-        elif x < 0.29:
+        elif x < 0.255:
             self._set_write()
-        elif x < 0.40:
+        elif x < 0.35:
             self._seq_write()
-        elif x < 0.51:
+        elif x < 0.43:
+            self._map_write()
+        elif x < 0.525:
             self._pull()
-        elif x < 0.57:
+        elif x < 0.575:
             self._set_pull()
-        elif x < 0.63:
+        elif x < 0.625:
             self._seq_pull()
-        elif x < 0.685:
+        elif x < 0.675:
+            self._map_pull()
+        elif x < 0.72:
             self._barrier()
-        elif x < 0.74:
+        elif x < 0.765:
             self._set_barrier()
-        elif x < 0.795:
+        elif x < 0.81:
             self._seq_barrier()
-        elif x < 0.855:
+        elif x < 0.845:
+            self._map_barrier()
+        elif x < 0.895:
             self._checkpoint()
-        elif x < 0.88:
+        elif x < 0.915:
             self._soft_toggle()
-        elif x < 0.925:
+        elif x < 0.955:
             self._sigkill()
         else:
             self._restore()
@@ -560,6 +670,7 @@ class CrashSoakRunner:
             # still missing somewhere — vv equality closes that hole
             vvs, set_vvs, set_members = [], [], []
             seq_vvs, seq_items = [], []
+            map_views, map_items = [], []
             for d in self.daemons:
                 code, body = _http(d.url + "/vv")
                 vvs.append(json.loads(body)["vv"] if code == 200 else None)
@@ -579,6 +690,18 @@ class CrashSoakRunner:
                 seq_items.append(
                     json.loads(body)["items"] if code == 200 else None
                 )
+                code, body = _http(d.url + "/map/vv")
+                if code == 200:
+                    got = json.loads(body)
+                    # vv AND epochs must agree (an undelivered reset is
+                    # a divergence items-equality could miss)
+                    map_views.append((got["vv"], got["epochs"]))
+                else:
+                    map_views.append(None)
+                code, body = _http(d.url + "/map")
+                map_items.append(
+                    json.loads(body)["items"] if code == 200 else None
+                )
             if (
                 all(s is not None for s in states)
                 and all(s == states[0] for s in states[1:])
@@ -587,6 +710,8 @@ class CrashSoakRunner:
                 and all(m == set_members[0] for m in set_members)
                 and all(v == seq_vvs[0] for v in seq_vvs)
                 and all(m == seq_items[0] for m in seq_items)
+                and all(v == map_views[0] for v in map_views)
+                and all(m == map_items[0] for m in map_items)
             ):
                 break
             assert rounds < max_rounds, f"liveness violated (I3): {states}"
@@ -601,6 +726,9 @@ class CrashSoakRunner:
                     code, body = _http(d.url + "/admin/seq_pull", "POST",
                                        {"peer": peer})
                     assert code == 200, f"Q3: heal seq pull 500d: {body!r}"
+                    code, body = _http(d.url + "/admin/map_pull", "POST",
+                                       {"peer": peer})
+                    assert code == 200, f"M3: heal map pull 500d: {body!r}"
             rounds += 1
         r.rounds_to_converge = rounds
 
@@ -758,6 +886,79 @@ class CrashSoakRunner:
             f"fleet={sorted(got_items)} oracle={want_items}"
         )
         r.final_len = len(got_items)
+
+        # ---- map invariants (M1/M2) over the converged fleet ----
+        code, body = _http(self.daemons[0].url + "/map/vv")
+        assert code == 200
+        got_map = json.loads(body)
+        map_vv = {int(k): int(v) for k, v in got_map["vv"].items()}
+        map_epochs = {str(k): int(e) for k, e in got_map["epochs"].items()}
+
+        # M2: heal-time epochs dominate the last successful barrier —
+        # a stale-snapshot restore must be absorbed, never roll epochs back
+        for k, e in self.last_map_epochs.items():
+            assert map_epochs.get(k, 0) >= e, (
+                f"M2: epoch rolled back at key {k}: {map_epochs} < "
+                f"{self.last_map_epochs}"
+            )
+
+        # M1a/M1b: watermark rules, same shape as I1a/I1b (the vv covers
+        # dominated-and-pruned ops too — they were SEEN, then voided)
+        for rid, bar in self.map_ckpt_watermark.items():
+            assert map_vv.get(rid, -1) >= bar - 1, (
+                f"M1a: checkpointed map ops lost: writer {rid} had {bar}, "
+                f"fleet holds {map_vv.get(rid, -1) + 1}"
+            )
+        for d in self.daemons:
+            rid = d.wire_rid
+            n = self.map_accepted_per_boot.get(rid, 0)
+            assert map_vv.get(rid, -1) == n - 1, (
+                f"M1b: live map writer {rid} accepted {n}, fleet holds "
+                f"{map_vv.get(rid, -1) + 1}"
+            )
+
+        # M1c: converged {key: value} == the epoch-filtered observed-
+        # remove PN fold of exactly the vv-surviving ops.  Reset-wins:
+        # an op whose mint epoch is below the key's final epoch is void.
+        map_survived = 0
+        per_key: Dict[str, Dict] = {}
+        for rid, seq, key, delta, e in self.map_upds:
+            if seq <= map_vv.get(rid, -1):
+                map_survived += 1
+                if e == map_epochs.get(key, 0):
+                    pk = per_key.setdefault(
+                        key, {"cnt": {}, "obs": {}, "val": 0}
+                    )
+                    pk["cnt"][rid] = pk["cnt"].get(rid, 0) + 1
+                    pk["val"] += delta
+        for rid, seq, key, obs, e in self.map_rems:
+            if seq <= map_vv.get(rid, -1):
+                map_survived += 1
+                if e == map_epochs.get(key, 0):
+                    pk = per_key.setdefault(
+                        key, {"cnt": {}, "obs": {}, "val": 0}
+                    )
+                    for w, t in obs.items():
+                        pk["obs"][w] = max(pk["obs"].get(w, -1), t)
+        want_map = {}
+        for key, pk in per_key.items():
+            contained = any(
+                cnt >= 1 and (cnt - 1) > pk["obs"].get(w, -1)
+                for w, cnt in pk["cnt"].items()
+            )
+            if contained:
+                want_map[key] = pk["val"]
+        r.map_ops_lost = (
+            len(self.map_upds) + len(self.map_rems) - map_survived
+        )
+        code, body = _http(self.daemons[0].url + "/map")
+        assert code == 200
+        got_map_items = json.loads(body)["items"]
+        assert got_map_items == want_map, (
+            f"M1c: map content diverged from the epoch-filtered "
+            f"surviving-op fold: fleet={got_map_items} oracle={want_map}"
+        )
+        r.final_map_keys = len(got_map_items)
         return r
 
     def close(self) -> None:
