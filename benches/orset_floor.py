@@ -1,0 +1,177 @@
+"""OR-Set union kernel movement-floor measurement (round-4 verdict task 4).
+
+The round-4 op-cut post-mortem proved the fused union kernel is
+data-movement bound on its sublane shifts (a 19% ALU cut bought 3.5%
+wall).  This driver measures the floor DIRECTLY: a kernel with the
+IDENTICAL pass structure — 11 merge-stage interleaves on 2 planes, the
+dup-punch's 3 shifted passes, 11 prefix shift-adds, 11 compaction passes
+on 2 planes — but with every comparator/select replaced by the cheapest
+possible combine (adds/ors of the shifted operands, so Mosaic cannot
+elide the movement).  Its wall time is what the union's data movement
+alone costs on this chip; the fused kernel's headroom above it is the
+most ANY further ALU/select optimization could win without changing the
+pass structure itself.
+
+Prints {floor_ms, fused_ms, headroom_pct} at the BASELINE shape.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from crdt_tpu.ops import pallas_union as pu
+from crdt_tpu.utils.constants import SENTINEL
+
+
+def _floor_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref, nu_ref):
+    """The union kernel's pass structure with free combines (see module
+    docstring).  Every _shift_up/_shift_down below moves exactly the rows
+    the real kernel's corresponding pass moves."""
+    c = ka_ref.shape[0]
+    n = 2 * c
+    out_rows = ko_ref.shape[0]
+    keys = jnp.concatenate([ka_ref[:], kbr_ref[:]], axis=0)
+    vals = jnp.concatenate([va_ref[:], vbr_ref[:]], axis=0)
+    # 11 merge stages: interleave movement on both planes (reshape +
+    # stack), combine = add (cannot be elided; no compare network)
+    stride = n // 2
+    while stride >= 1:
+        nb = n // (2 * stride)
+        rk = keys.reshape(nb, 2, stride, pu.LANES)
+        rv = vals.reshape(nb, 2, stride, pu.LANES)
+        keys = jnp.stack(
+            [rk[:, 0] + rk[:, 1], rk[:, 0] - rk[:, 1]], axis=1
+        ).reshape(n, pu.LANES)
+        vals = jnp.stack(
+            [rv[:, 0] | rv[:, 1], rv[:, 0] ^ rv[:, 1]], axis=1
+        ).reshape(n, pu.LANES)
+        stride //= 2
+    # dup punch's 3 shifted passes
+    keys = keys + pu._shift_down(keys, 1, SENTINEL)
+    vals = vals | pu._shift_up(vals, 1, 0)
+    keys = keys ^ pu._shift_up(keys, 1, 0)
+    # 11 prefix shift-adds on one plane
+    p = (keys & 1).astype(jnp.int32)
+    s = 1
+    while s < n:
+        p = p + pu._shift_down(p, s, 0)
+        s *= 2
+    disp = p | (vals << pu.FLAG_SHIFT)
+    nu_ref[:] = p[n - 1 : n]
+    # 11 compaction passes on two planes (the round-5 packed-disp form)
+    s = 1
+    while s < n:
+        keys = keys + _shift_cheap(keys, s)
+        disp = disp | _shift_cheap(disp, s)
+        s *= 2
+    ko_ref[:] = keys[:out_rows]
+    vo_ref[:] = disp[:out_rows] >> pu.FLAG_SHIFT
+
+
+def _shift_cheap(x, s):
+    return pu._shift_up(x, s, 0)
+
+
+def floor_union(keys_a, vals_a, keys_b, vals_b, out_size, interpret=False):
+    c, lanes = keys_a.shape
+    grid = (lanes // pu.LANES,)
+    in_spec = pl.BlockSpec((c, pu.LANES), lambda i: (0, i))
+    out_spec = pl.BlockSpec((out_size, pu.LANES), lambda i: (0, i))
+    nu_spec = pl.BlockSpec((1, pu.LANES), lambda i: (0, i))
+    return pl.pallas_call(
+        _floor_kernel,
+        grid=grid,
+        in_specs=[in_spec] * 4,
+        out_specs=[out_spec, out_spec, nu_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((out_size, lanes), jnp.int32),
+            jax.ShapeDtypeStruct((out_size, lanes), jnp.int32),
+            jax.ShapeDtypeStruct((1, lanes), jnp.int32),
+        ],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=96 * 1024 * 1024,
+        ),
+    )(keys_a, vals_a, jnp.flip(keys_b, axis=0), jnp.flip(vals_b, axis=0))
+
+
+def _timed_union(fn, ka, va, kb, vb, c, bank_n=1, k_small=8, k_large=32):
+    @partial(jax.jit, static_argnames="k")
+    def chained(ka, va, kb, vb, k):
+        def body(i, carry):
+            kx, vx = carry
+            ko, vo, _ = fn(kx, vx, kb, vb)
+            return ko, vo
+
+        ko, vo = jax.lax.fori_loop(0, k, body, (ka, va))
+        return ko.sum() + vo.sum()
+
+    def run(k):
+        int(chained(ka, va, kb, vb, k))
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            int(chained(ka, va, kb, vb, k))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1, t2 = run(k_small), run(k_large)
+    return (t2 - t1) / (k_large - k_small)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--capacity", type=int, default=1024)
+    ap.add_argument("--lanes", type=int, default=1 << 17)
+    args = ap.parse_args()
+    c, ln = args.capacity, args.lanes
+    from benches.bench_baseline import _enable_compile_cache
+
+    _enable_compile_cache()
+    ks = jax.random.split(jax.random.key(4), 2)
+
+    def cols(key, fill):
+        kk = jax.random.randint(key, (c, ln), 0, 1 << 30, dtype=jnp.int32)
+        kk = jax.lax.sort(kk, dimension=0)
+        keys = jnp.where(jnp.arange(c)[:, None] < fill, kk, SENTINEL)
+        return keys, (kk & 1).astype(jnp.int32)
+
+    ka, va = cols(ks[0], c // 2)
+    kb, vb = cols(ks[1], c // 2)
+
+    per_floor = _timed_union(
+        lambda a, b, x, y: floor_union(a, b, x, y, out_size=c),
+        ka, va, kb, vb, c,
+    )
+    per_fused = _timed_union(
+        lambda a, b, x, y: pu.sorted_union_columnar_fused(
+            a, b, x, y, out_size=c
+        ),
+        ka, va, kb, vb, c,
+    )
+    headroom = 100 * (per_fused - per_floor) / per_fused
+    print(json.dumps({
+        "capacity": c, "lanes": ln,
+        "floor_ms": round(per_floor * 1e3, 2),
+        "fused_ms": round(per_fused * 1e3, 2),
+        "headroom_pct": round(headroom, 1),
+        "note": "floor = identical pass structure (11 merge interleaves x "
+                "2 planes, 3 punch passes, 11 prefix shift-adds, 11 "
+                "compaction passes x 2 planes), comparators replaced by "
+                "free combines — the cost of the data movement alone",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

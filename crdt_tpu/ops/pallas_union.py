@@ -199,6 +199,14 @@ def _hole_compact(key_planes, val_planes, n):
     return key_planes, val_planes, nu_row
 
 
+# the tombstone flag rides the displacement plane's high bits during
+# compaction (see _union_kernel): disp < 2C <= 2^13 uses the low bits,
+# the flag sits at bit FLAG_SHIFT, and take/keep bit-tests plus the
+# cand_d - s subtraction never touch it (no borrow past the low bits:
+# a TAKEN row has (cand_d & s) != 0, so its low part >= s)
+FLAG_SHIFT = 16
+
+
 def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref, nu_ref):
     """FUSED columnar union: bitonic merge + adjacent-dup OR-combine +
     log-step hole compaction, entirely in VMEM — one HBM round trip for the
@@ -216,6 +224,16 @@ def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref, nu_ref):
          order makes displacements monotone per column, so take/keep never
          collide (validated against a host oracle in tests).
 
+    Round-5 movement cut (the round-4 post-mortem's verdict was that this
+    kernel is data-movement bound on its sublane shifts, so the lever is
+    moving fewer plane-rows): the value plane is a 0/1 tombstone FLAG
+    (every caller's contract — orset's ``removed`` plane), so after the
+    punch it is folded into the displacement plane's high bits
+    (``disp | flag << FLAG_SHIFT``) and the compaction moves TWO planes
+    (keys, disp+flag) instead of three — one fewer sublane-shift pass and
+    one fewer select per compaction step, ~1/3 of the dominant stage's
+    data movement.
+
     ``ko_ref``/``vo_ref`` may be SHORTER than 2C rows (static out_size
     truncation): only their row count is written back to HBM — a
     capacity-bounded union (OpLog/OR-Set merge at fixed capacity C) then
@@ -224,6 +242,10 @@ def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref, nu_ref):
     """
     c = ka_ref.shape[0]
     n = 2 * c
+    assert n < (1 << FLAG_SHIFT) - 1, (
+        f"union of {n} rows overflows the disp low bits (FLAG_SHIFT="
+        f"{FLAG_SHIFT}); raise FLAG_SHIFT"
+    )
     out_rows = ko_ref.shape[0]
     keys = jnp.concatenate([ka_ref[:], kbr_ref[:]], axis=0)
     vals = jnp.concatenate([va_ref[:], vbr_ref[:]], axis=0)
@@ -243,10 +265,30 @@ def _union_kernel(ka_ref, va_ref, kbr_ref, vbr_ref, ko_ref, vo_ref, nu_ref):
     keys = jnp.where(dup, SENTINEL, keys)
     vals = jnp.where(dup, 0, vals)
 
-    (keys,), (vals,), nu_row = _hole_compact([keys], [vals], n)
+    # prefix-sum displacements (stage 3), then fold the flag into disp
+    hole = keys == SENTINEL
+    p = hole.astype(jnp.int32)
+    s = 1
+    while s < n:
+        p = p + _shift_down(p, s, 0)
+        s *= 2
+    disp = jnp.where(hole, 0, p - hole.astype(jnp.int32))
+    nu_row = n - p[n - 1 : n]
+    disp = disp | (vals << FLAG_SHIFT)
+
+    # compaction (stage 4) on TWO planes: keys + flag-carrying disp
+    s = 1
+    while s < n:
+        cand_k = _shift_up(keys, s, SENTINEL)
+        cand_d = _shift_up(disp, s, 0)
+        take = (cand_d & s) != 0
+        keep = (disp & s) == 0
+        keys = jnp.where(take, cand_k, jnp.where(keep, keys, SENTINEL))
+        disp = jnp.where(take, cand_d - s, jnp.where(keep, disp, 0))
+        s *= 2
     nu_ref[:] = nu_row
     ko_ref[:] = keys[:out_rows]
-    vo_ref[:] = vals[:out_rows]
+    vo_ref[:] = disp[:out_rows] >> FLAG_SHIFT
 
 
 @partial(jax.jit, static_argnames=("out_size", "interpret"))
@@ -261,6 +303,11 @@ def sorted_union_columnar_fused(
     """Fused-kernel batched sorted-set union (see _union_kernel): same
     contract as sorted_union_columnar, values OR-combined on duplicates.
     Returns (keys[out, L], vals[out, L], n_unique[L]).
+
+    Value-plane bound (round-5): values must be < 2^15 (in practice the
+    0/1 tombstone flag every caller passes) — the kernel folds them into
+    the displacement plane's high bits to cut compaction movement; wider
+    values belong on the lexN kernel's value planes.
 
     ``out_size`` is applied INSIDE the kernel (static output block shape):
     a capacity-bounded union (out_size == C) writes half the output bytes
