@@ -67,6 +67,7 @@ from crdt_tpu.utils.intern import Interner
 from crdt_tpu.utils.metrics import Metrics
 
 EPOCH_KEY = "__epochs__"
+VV_KEY = "__vv__"
 
 
 def _wire_key(rid: int, seq: int) -> str:
@@ -241,6 +242,17 @@ class MapNode:
             ep = self._epochs_locked()
             if ep or payload:
                 payload[EPOCH_KEY] = ep
+            # the vv section restores watermark convergence across reset
+            # pruning: an op a reset voided is PRUNED from the sender's
+            # records and never re-sent, so a receiver that missed it
+            # would keep a permanent vv hole without this.  Max-adopting
+            # the sender's vv is safe because every op at or under it is
+            # either in this payload (retained, above `since`), already
+            # held, or pruned-void (dominated by an epoch this payload
+            # also carries) — the floor-extends-knowledge rule the
+            # set/seq nodes use, epoch-wise.
+            if self._vv:
+                payload[VV_KEY] = {str(r): s for r, s in self._vv.items()}
             return payload
 
     def receive(self, payload: Optional[Dict[str, Any]]) -> int:
@@ -254,11 +266,19 @@ class MapNode:
             str(k): int(e)
             for k, e in (payload.pop(EPOCH_KEY, None) or {}).items()
         }
+        remote_vv = {
+            int(r): int(s)
+            for r, s in (payload.pop(VV_KEY, None) or {}).items()
+        }
         rows = [(_parse_wire_key(k), op) for k, op in payload.items()]
         with self._lock:
             if epochs:
                 self._adopt_epochs_locked(epochs)
-            return self._ingest_locked(rows)
+            fresh = self._ingest_locked(rows)
+            for r, s2 in remote_vv.items():
+                if s2 > self._vv.get(r, -1):
+                    self._vv[r] = s2
+            return fresh
 
     # ---- reset barrier surface ----
 

@@ -117,9 +117,10 @@ def test_crash_loses_only_post_snapshot_suffix(fleet):
 
 def test_random_crash_schedule(request):
     steps = 300 if request.config.getoption("--long") else 60
-    # seed 8 under the round-4 step distribution: 3 SIGKILLs/restores,
-    # 4 checkpoints, 8 KV + 7 set + 3 seq ops in 60 steps (probed)
-    runner = CrashSoakRunner(n=3, seed=8)
+    # seed 3 under the round-5 step distribution (map workload added):
+    # 2 SIGKILLs/restores, 3 checkpoints, 6 KV + 3 set + 4 seq + 2 map
+    # ops in 60 steps (probed)
+    runner = CrashSoakRunner(n=3, seed=3)
     report = runner.run(steps)
     # the schedule must actually exercise the crash machinery
     assert report.sigkills >= 1 and report.restores >= 1, report
@@ -129,6 +130,7 @@ def test_random_crash_schedule(request):
     # the set AND seq workloads must be exercised by the same schedule
     assert report.set_adds >= 1, report
     assert report.seq_inserts >= 1, report
+    assert report.map_upds >= 1, report
 
 
 def _set_add(runner, slot, elem):

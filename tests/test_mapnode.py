@@ -172,3 +172,23 @@ def test_delta_payload_carries_epochs_and_is_always_valid():
     b.receive(p)
     assert b.epochs() == {"k": 1}
     assert b.items() == {}
+
+
+def test_vv_reconverges_across_reset_pruning():
+    """The crashsoak-found bug (round-5): a reset prunes dominated ops
+    from every holder, so a replica that never received them could keep
+    a permanent vv hole — the payload's vv section must close it."""
+    a, b, c = MapNode(rid=0), MapNode(rid=1), MapNode(rid=2)
+    a.upd("k", 5)
+    sync(a, b)  # c never sees (0, 0)
+    b.rem("k")
+    sync(a, b)
+    assert map_barrier_ready(a, [b.version_vector()])
+    a.mint_reset()  # (0,0) and b's remove now pruned everywhere that held them
+    pull(b, a)
+    # c pulls from a: the voided ops are gone from a's records, but the
+    # vv section covers them — c's vv must converge to the fleet's
+    pull(c, a)
+    assert c.version_vector() == a.version_vector()
+    assert c.epochs() == {"k": 1}
+    assert c.items() == {}
