@@ -314,13 +314,26 @@ class MapSoakReport:
     barriers_skipped: int = 0  # dead member -> full-fleet rule skipped it
     keys_reset: int = 0
     final_present: int = 0
+    # churn gauges (round-5 task 6): how often the full-fleet rule lets a
+    # barrier fire under this schedule, and how much reclaimable state
+    # accumulates while it cannot
+    peak_unreclaimed: int = 0      # max keys with history, removed, unreset
+    unreclaimed_at_end: int = 0
+
+    @property
+    def barrier_fire_rate(self) -> float:
+        """Fired barriers / attempts (fired + skipped-by-churn)."""
+        att = self.barriers + self.barriers_skipped
+        return self.barriers / att if att else 0.0
 
     def __str__(self) -> str:
         return (
             f"map-soak: {self.steps} steps, {self.updates} updates / "
             f"{self.removes} removes, {self.joins} joins, {self.kills} "
             f"kills / {self.revivals} revivals, {self.snapshots} snaps / "
-            f"{self.restores} stale restores, {self.barriers} barriers "
+            f"{self.restores} stale restores, fire-rate "
+            f"{self.barrier_fire_rate:.2f}, peak-unreclaimed "
+            f"{self.peak_unreclaimed}, {self.barriers} barriers "
             f"({self.barriers_noop} no-op, {self.barriers_skipped} "
             f"skipped), {self.keys_reset} keys reset, "
             f"final present {self.final_present}"
@@ -530,9 +543,27 @@ class MapSoakRunner:
         self.report.restores += 1
         self._check(i, "restore")
 
+    def _unreclaimed(self, i: int) -> int:
+        """Keys with history whose removal is folded but not yet reset at
+        replica i — the state a fired barrier would reclaim (mirror-side:
+        no device roundtrip)."""
+        m = self.mirrors[i]
+        return sum(
+            1 for k in range(self.n_keys)
+            if any(t > -1 for t in m.tok[k]) and not m.contains(k)
+        )
+
+    def _sample_unreclaimed(self) -> None:
+        for i in range(self.n):
+            if self.alive[i]:
+                self.report.peak_unreclaimed = max(
+                    self.report.peak_unreclaimed, self._unreclaimed(i)
+                )
+
     def _barrier(self) -> None:
         from crdt_tpu.models import ormap_gc
 
+        self._sample_unreclaimed()
         sw, n_reset = ormap_gc.reset_barrier(
             swarm.make(
                 jax.tree.map(lambda *xs: jnp.stack(xs), *self.states),
@@ -578,11 +609,18 @@ class MapSoakRunner:
             if x < acc:
                 action()
                 break
+        if self.report.steps % 8 == 0:
+            self._sample_unreclaimed()
         self.report.steps += 1
 
     def heal_and_check(self) -> MapSoakReport:
         from crdt_tpu.models import ormap_gc
 
+        self._sample_unreclaimed()
+        self.report.unreclaimed_at_end = max(
+            (self._unreclaimed(i) for i in range(self.n) if self.alive[i]),
+            default=0,
+        )
         self.alive = [True] * self.n
         for _ in range(self.n):
             for i in range(self.n):
