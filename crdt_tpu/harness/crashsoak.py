@@ -55,10 +55,14 @@ hardest untested interaction.  Set invariants at heal:
                       exactly the vv-surviving set ops (no resurrection of
                       collected tags, no lost removal — both falsify the
                       fold); checkpointed/live-writer watermark rules as I1.
-  S2  floor safety  — every node's heal-time GC floor dominates the last
-                      successful barrier's floor (floors are monotone
-                      across incarnation restores; a stale-snapshot floor
-                      must be absorbed, never roll the fleet back).
+  S2  floor safety  — every node's heal-time GC floor dominates the
+                      strongest floor any slot still DURABLY holds
+                      (in memory, or in the snapshot a crash reverts it
+                      to): a stale restore is absorbed while any durable
+                      holder exists.  A fleet-wide revert to pre-barrier
+                      snapshots legitimately rolls the floor back
+                      (gossip-as-checkpoint: the collected rows revert
+                      WITH it — round-5 n=3 sweep finding).
   S3  safety        — no set pull/collect/barrier ever 500s (the floor
                       chain rule holds on every schedule).
 
@@ -284,7 +288,19 @@ class CrashSoakRunner:
         self.set_removes: List[Tuple[int, int, List[Tuple[int, int]]]] = []
         self.set_accepted_per_boot: Dict[int, int] = {}
         self.set_ckpt_watermark: Dict[int, int] = {}
-        self.last_set_floor: Dict[int, int] = {}      # S2 monotonicity bar
+        # S2 bookkeeping (round-5 rework, found by the n=3 sweep): the
+        # barrier floor is DURABLE only while some daemon holds it in
+        # memory or on disk — if every holder is SIGKILLed before
+        # checkpointing, the fleet legitimately reverts to pre-barrier
+        # state wholesale (gossip-as-checkpoint: nothing was lost,
+        # the collected rows come back with the floor).  So the
+        # monotonicity bar is per-slot: what each daemon currently holds
+        # (queried after barriers) and what its last snapshot would
+        # restore.  The heal-time floor must dominate the per-writer max
+        # over slots AFTER applying crash reversion — not the last
+        # barrier's floor unconditionally.
+        self.set_floor_live: Dict[int, Dict[int, int]] = {}
+        self.set_floor_ckpt: Dict[int, Dict[int, int]] = {}
         self.set_elems = [f"s{i}" for i in range(n_keys)]
         # sequence-lattice oracle: inserts (rid, seq, elem) with fleet-
         # unique elems, removes (rid, seq, target identity)
@@ -292,14 +308,16 @@ class CrashSoakRunner:
         self.seq_removes: List[Tuple[int, int, Tuple[int, int]]] = []
         self.seq_accepted_per_boot: Dict[int, int] = {}
         self.seq_ckpt_watermark: Dict[int, int] = {}
-        self.last_seq_floor: Dict[int, int] = {}      # Q2 monotonicity bar
+        self.seq_floor_live: Dict[int, Dict[int, int]] = {}   # Q2: as S2
+        self.seq_floor_ckpt: Dict[int, Dict[int, int]] = {}
         # map-lattice oracle: upds (rid, seq, key, delta, epoch_at_mint),
         # rems (rid, seq, key, {writer: observed_tok}, epoch_at_mint)
         self.map_upds: List[Tuple[int, int, str, int, int]] = []
         self.map_rems: List[Tuple[int, int, str, Dict[int, int], int]] = []
         self.map_accepted_per_boot: Dict[int, int] = {}
         self.map_ckpt_watermark: Dict[int, int] = {}
-        self.last_map_epochs: Dict[str, int] = {}     # M2 monotonicity bar
+        self.map_epoch_live: Dict[int, Dict[str, int]] = {}   # M2: as S2
+        self.map_epoch_ckpt: Dict[int, Dict[str, int]] = {}
         self.map_keys = [f"m{i}" for i in range(max(3, n_keys // 2))]
         self.report = CrashReport()
 
@@ -325,6 +343,40 @@ class CrashSoakRunner:
 
     def _running(self) -> List[Daemon]:
         return [d for d in self.daemons if d.running]
+
+    @staticmethod
+    def _dict_max(dicts):
+        """Per-key max over a list of {k: v} dicts — the strongest floor/
+        epoch any slot still durably holds."""
+        out = {}
+        for d in dicts:
+            for k, v in d.items():
+                if v > out.get(k, -1):
+                    out[k] = v
+        return out
+
+    def _query_floor(self, d: Daemon, path: str, field: str = "floor"):
+        code, body = _http(d.url + path)
+        if code != 200:
+            return None
+        got = json.loads(body)[field]
+        if field == "epochs":
+            return {str(k): int(v) for k, v in got.items()}
+        return {int(k): int(v) for k, v in got.items()}
+
+    def _refresh_live(self) -> None:
+        """Record every running daemon's actual floors/epochs (the
+        durable-holder bookkeeping above)."""
+        for d in self._running():
+            f = self._query_floor(d, "/set/vv")
+            if f is not None:
+                self.set_floor_live[d.slot] = f
+            f = self._query_floor(d, "/seq/vv")
+            if f is not None:
+                self.seq_floor_live[d.slot] = f
+            e = self._query_floor(d, "/map/vv", field="epochs")
+            if e is not None:
+                self.map_epoch_live[d.slot] = e
 
     # ---- set-lattice actions (S-invariants) ----
 
@@ -386,13 +438,16 @@ class CrashSoakRunner:
         assert code == 200, f"S3: set barrier 500d: {body!r}"
         floor = {int(k): int(v) for k, v in json.loads(body)["floor"].items()}
         if floor:
-            # S2 bookkeeping: successful barriers advance monotonically
-            for k, v in self.last_set_floor.items():
+            # S2 chain rule: a minted floor dominates every member's
+            # current floor (the durable-holder bars, which crash
+            # reversion may have lowered — see __init__ note)
+            bar = self._dict_max(self.set_floor_live.values())
+            for k, v in bar.items():
                 assert floor.get(k, -1) >= v, (
                     f"S2: barrier floor regressed at writer {k}: "
-                    f"{floor} < {self.last_set_floor}"
+                    f"{floor} < holder bar {bar}"
                 )
-            self.last_set_floor = floor
+            self._refresh_live()
             self.report.set_barriers += 1
         else:
             self.report.set_barriers_empty += 1
@@ -454,12 +509,13 @@ class CrashSoakRunner:
         assert code == 200, f"Q3: seq barrier 500d: {body!r}"
         floor = {int(k): int(v) for k, v in json.loads(body)["floor"].items()}
         if floor:
-            for k, v in self.last_seq_floor.items():
+            bar = self._dict_max(self.seq_floor_live.values())
+            for k, v in bar.items():
                 assert floor.get(k, -1) >= v, (
                     f"Q2: barrier floor regressed at writer {k}: "
-                    f"{floor} < {self.last_seq_floor}"
+                    f"{floor} < holder bar {bar}"
                 )
-            self.last_seq_floor = floor
+            self._refresh_live()
             self.report.seq_barriers += 1
         else:
             self.report.seq_barriers_empty += 1
@@ -532,13 +588,15 @@ class CrashSoakRunner:
         got = json.loads(body)
         if got["status"] == "reset":
             epochs = {str(k): int(e) for k, e in got["epochs"].items()}
-            # M2 bookkeeping: successful resets advance epochs monotonically
-            for k, e in self.last_map_epochs.items():
-                assert epochs.get(k, 0) >= e or k not in epochs, (
+            # M2: a minted reset strictly advances every key it touches
+            # past any durable holder's epoch
+            bar = self._dict_max(self.map_epoch_live.values())
+            for k, e in epochs.items():
+                assert e > bar.get(k, 0) - 1, (
                     f"M2: epoch regressed at key {k}: {epochs} < "
-                    f"{self.last_map_epochs}"
+                    f"holder bar {bar}"
                 )
-            self.last_map_epochs.update(epochs)
+            self._refresh_live()
             self.report.map_barriers += 1
         elif got["status"] == "noop":
             self.report.map_barriers_noop += 1
@@ -581,6 +639,16 @@ class CrashSoakRunner:
         self.set_ckpt_watermark[rid] = self.set_accepted_per_boot.get(rid, 0)
         self.seq_ckpt_watermark[rid] = self.seq_accepted_per_boot.get(rid, 0)
         self.map_ckpt_watermark[rid] = self.map_accepted_per_boot.get(rid, 0)
+        # durable-holder bookkeeping: what THIS snapshot would restore
+        f = self._query_floor(d, "/set/vv")
+        if f is not None:
+            self.set_floor_ckpt[d.slot] = f
+        f = self._query_floor(d, "/seq/vv")
+        if f is not None:
+            self.seq_floor_ckpt[d.slot] = f
+        e = self._query_floor(d, "/map/vv", field="epochs")
+        if e is not None:
+            self.map_epoch_ckpt[d.slot] = e
         self.report.checkpoints += 1
 
     def _soft_toggle(self) -> None:
@@ -600,7 +668,19 @@ class CrashSoakRunner:
         running = [d for d in self.daemons if d.running]
         if len(running) <= 1:
             return  # keep at least one survivor holding the gossip history
-        self.rng.choice(running).sigkill()
+        d = self.rng.choice(running)
+        d.sigkill()
+        # crash reversion: this slot now durably holds only what its last
+        # snapshot recorded (nothing, if it never checkpointed)
+        self.set_floor_live[d.slot] = dict(
+            self.set_floor_ckpt.get(d.slot, {})
+        )
+        self.seq_floor_live[d.slot] = dict(
+            self.seq_floor_ckpt.get(d.slot, {})
+        )
+        self.map_epoch_live[d.slot] = dict(
+            self.map_epoch_ckpt.get(d.slot, {})
+        )
         self.report.sigkills += 1
 
     def _restore(self) -> None:
@@ -778,13 +858,16 @@ class CrashSoakRunner:
         set_vv = {int(k): int(v) for k, v in got_set["vv"].items()}
         set_floor = {int(k): int(v) for k, v in got_set["floor"].items()}
 
-        # S2: the heal-time floor dominates the last successful barrier —
-        # a restore from a pre-barrier snapshot must be absorbed by the
-        # chain rule, never roll the fleet's floor back
-        for k, v in self.last_set_floor.items():
+        # S2: the heal-time floor dominates the strongest floor any slot
+        # still durably held (memory or snapshot) after crash reversion —
+        # a stale-snapshot restore must be absorbed while a durable
+        # holder exists; a fleet-wide pre-barrier revert is legitimate
+        # (gossip-as-checkpoint; see __init__ note)
+        bar = self._dict_max(self.set_floor_live.values())
+        for k, v in bar.items():
             assert set_floor.get(k, -1) >= v, (
                 f"S2: floor rolled back at writer {k}: {set_floor} < "
-                f"{self.last_set_floor}"
+                f"holder bar {bar}"
             )
 
         # S1a/S1b: watermark rules, same shape as I1a/I1b
@@ -837,11 +920,12 @@ class CrashSoakRunner:
         seq_vv = {int(k): int(v) for k, v in got_seq["vv"].items()}
         seq_floor = {int(k): int(v) for k, v in got_seq["floor"].items()}
 
-        # Q2: heal-time floor dominates the last successful barrier
-        for k, v in self.last_seq_floor.items():
+        # Q2: as S2 — dominance over the durable-holder bar
+        bar = self._dict_max(self.seq_floor_live.values())
+        for k, v in bar.items():
             assert seq_floor.get(k, -1) >= v, (
                 f"Q2: floor rolled back at writer {k}: {seq_floor} < "
-                f"{self.last_seq_floor}"
+                f"holder bar {bar}"
             )
 
         # Q1a/Q1b: watermark rules
@@ -894,12 +978,12 @@ class CrashSoakRunner:
         map_vv = {int(k): int(v) for k, v in got_map["vv"].items()}
         map_epochs = {str(k): int(e) for k, e in got_map["epochs"].items()}
 
-        # M2: heal-time epochs dominate the last successful barrier —
-        # a stale-snapshot restore must be absorbed, never roll epochs back
-        for k, e in self.last_map_epochs.items():
+        # M2: as S2/Q2 — heal-time epochs dominate the durable-holder bar
+        bar = self._dict_max(self.map_epoch_live.values())
+        for k, e in bar.items():
             assert map_epochs.get(k, 0) >= e, (
                 f"M2: epoch rolled back at key {k}: {map_epochs} < "
-                f"{self.last_map_epochs}"
+                f"holder bar {bar}"
             )
 
         # M1a/M1b: watermark rules, same shape as I1a/I1b (the vv covers
