@@ -212,15 +212,27 @@ def bench_pncounter_1m(results, tiny):
     )
 
 
-def bench_lww_argmax(results, tiny):
-    """100K registers: lexicographic (ts, rid) argmax select join."""
+def bench_lww_argmax(results, tiny, r=None, bank_n=8, suffix="", note=""):
+    """100K registers: lexicographic (ts, rid) argmax select join.  Reused
+    at 16M registers (bench_lww_16m) for the streaming-size datapoint.
+
+    The register planes are 2-D ``(r // 128, 128)`` at streaming sizes:
+    the chip's measured layout sweep (PERF.md) shows flat 1-D collapses to
+    ~0.26 TB/s while any 2-D lane-aligned layout streams at 83-89% of
+    spec.  The bank stays a pytree of separate ts/rid/payload banks so
+    each dynamic slice fuses as the producer of its select (the PN 1M
+    peer-bank-temp lesson, `benches/pn_diag.py`)."""
     import jax
     import jax.numpy as jnp
 
     from crdt_tpu.models import lww
 
-    r = 1 << 10 if tiny else 100_352  # 98 * 1024 (lane-aligned ~100K)
-    bank_n = 8
+    r = r or (1 << 10 if tiny else 100_352)  # 98 * 1024 (lane-aligned ~100K)
+    # 2-D only at streaming sizes: the committed 100K row was measured on
+    # the 1-D layout (dispatch-dominated there, so layout is immaterial —
+    # but don't silently change a committed row's conditions).
+    shape = ((r // 128, 128)
+             if r % 128 == 0 and 3 * r * 4 > VMEM_CARRY_BUDGET else (r,))
     ks = jax.random.split(jax.random.key(3), 4)
 
     def rand_reg(kt, kr, kp, shape):
@@ -230,9 +242,9 @@ def bench_lww_argmax(results, tiny):
             payload=jax.random.randint(kp, shape, 0, 1 << 20, dtype=jnp.int32),
         )
 
-    a = rand_reg(ks[0], ks[1], ks[2], (r,))
+    a = rand_reg(ks[0], ks[1], ks[2], shape)
     bks = jax.random.split(ks[3], 3)
-    bank = rand_reg(bks[0], bks[1], bks[2], (bank_n, r))
+    bank = rand_reg(bks[0], bks[1], bks[2], (bank_n,) + shape)
 
     @partial(jax.jit, static_argnames="k")
     def chained(a, bank, k):
@@ -245,12 +257,28 @@ def bench_lww_argmax(results, tiny):
         out = jax.lax.fori_loop(0, k, body, a)
         return out.ts.sum() + out.payload.sum()
 
-    ks_, kl = (8, 32) if tiny else (128, 1024)
+    ks_, kl = (8, 32) if tiny else ((32, 256) if r >= 1 << 23 else (128, 1024))
     per = _timed(lambda k: int(chained(a, bank, k)), ks_, kl,
                  min_diff=0 if tiny else MIN_DIFF_S)
-    _emit(results, "lww_argmax_replica_merges_per_sec", r / per,
-          "replica-merges/s", f"{r}-register (ts, rid) argmax join",
+    _emit(results, f"lww_argmax_replica_merges_per_sec{suffix}", r / per,
+          "replica-merges/s",
+          note or f"{r}-register (ts, rid) argmax join",
           bytes_per_step=_hbm_bytes_per_step(3 * r * 4), sec_per_step=per)
+
+
+def bench_lww_16m(results, tiny):
+    """Streaming-size LWW point: 16M registers x 3 planes = 192 MB state
+    (past the VMEM carry budget, so every step pays read-self + read-peer
+    + write on all three planes).  Exists so the counter-family
+    'HBM-bound at streaming sizes' claim is MEASURED for the register
+    lattice too -- the 100K row is dispatch-dominated (1.1 MB state) and
+    its low %-spec is otherwise easy to misread as a regression."""
+    bench_lww_argmax(
+        results, tiny, r=(1 << 14 if tiny else 1 << 24), bank_n=4,
+        suffix="_16m",
+        note=("16777216-register (ts, rid) argmax join, (131072, 128) "
+              "2-D planes" if not tiny else None),
+    )
 
 
 def _enable_compile_cache():
@@ -482,6 +510,7 @@ ALL = {
     "pncounter_vmap": bench_pncounter_vmap,
     "pncounter_1m": bench_pncounter_1m,
     "lww_argmax": bench_lww_argmax,
+    "lww_16m": bench_lww_16m,
     "orset_union": bench_orset_union,
     "orset_sweep": bench_orset_sweep,
     "orset_1m": bench_orset_1m,
