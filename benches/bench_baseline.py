@@ -214,7 +214,7 @@ def bench_pncounter_1m(results, tiny):
 
 def bench_lww_argmax(results, tiny, r=None, bank_n=8, suffix="", note=""):
     """100K registers: lexicographic (ts, rid) argmax select join.  Reused
-    at 16M registers (bench_lww_16m) for the streaming-size datapoint.
+    at 32M registers (bench_lww_32m) for the streaming-size datapoint.
 
     The register planes are 2-D ``(r // 128, 128)`` at streaming sizes:
     the chip's measured layout sweep (PERF.md) shows flat 1-D collapses to
@@ -266,19 +266,80 @@ def bench_lww_argmax(results, tiny, r=None, bank_n=8, suffix="", note=""):
           bytes_per_step=_hbm_bytes_per_step(3 * r * 4), sec_per_step=per)
 
 
-def bench_lww_16m(results, tiny):
-    """Streaming-size LWW point: 16M registers x 3 planes = 192 MB state
-    (past the VMEM carry budget, so every step pays read-self + read-peer
-    + write on all three planes).  Exists so the counter-family
-    'HBM-bound at streaming sizes' claim is MEASURED for the register
-    lattice too -- the 100K row is dispatch-dominated (1.1 MB state) and
-    its low %-spec is otherwise easy to misread as a regression."""
+def bench_lww_32m(results, tiny):
+    """Streaming-size LWW point: 32M registers x 3 planes = 384 MB state
+    (decisively past BOTH the VMEM carry budget and physical VMEM, so
+    every step pays read-self + read-peer + write on all three planes).
+    Exists so the counter-family 'HBM-bound at streaming sizes' claim is
+    MEASURED for the register lattice too -- the 100K row is
+    dispatch-dominated (1.1 MB state) and its low %-spec is otherwise
+    easy to misread as a regression.  32M, not 16M: at 16M the PACKED
+    sibling's carry is exactly the 128 MB physical VMEM and measurements
+    flip-flop 9x between resident and spilled runs (benches/lww_diag.py
+    header); both configs sit at the same register count so the packed
+    speedup is apples-to-apples."""
     bench_lww_argmax(
-        results, tiny, r=(1 << 14 if tiny else 1 << 24), bank_n=4,
-        suffix="_16m",
-        note=("16777216-register (ts, rid) argmax join, (131072, 128) "
+        results, tiny, r=(1 << 14 if tiny else 1 << 25), bank_n=4,
+        suffix="_32m",
+        note=("33554432-register (ts, rid) argmax join, (262144, 128) "
               "2-D planes" if not tiny else None),
     )
+
+
+def bench_lww_32m_packed(results, tiny):
+    """The packed LWW fast path at the 32M-register streaming shape: the
+    (ts, rid) pair packed order-preservingly into ONE key plane
+    (lww.pack/join_packed), so each step streams 6 planes instead of 9
+    and resolves with one compare instead of the cross-plane mask.
+    Diagnosis that motivated it: `benches/lww_diag.py` (the mask program
+    alone costs +37% over plain maxima on identical streams)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.models import lww
+
+    r = 1 << 14 if tiny else 1 << 25
+    bank_n = 4
+    rid_bits = 7  # bench rids span [0, 64): one past the default-6 budget
+    shape = (r // 128, 128)
+    ks = jax.random.split(jax.random.key(3), 4)
+
+    def rand_reg(kt, kr, kp, shape):
+        return lww.LWWRegister(
+            ts=jax.random.randint(kt, shape, 0, 1 << 20, dtype=jnp.int32),
+            rid=jax.random.randint(kr, shape, 0, 64, dtype=jnp.int32),
+            payload=jax.random.randint(kp, shape, 0, 1 << 20, dtype=jnp.int32),
+        )
+
+    a = rand_reg(ks[0], ks[1], ks[2], shape)
+    assert bool(lww.pack_budget_ok(a, rid_bits))
+    pa = lww.pack(a, rid_bits)
+    bks = jax.random.split(ks[3], 3)
+    bank = lww.pack(rand_reg(bks[0], bks[1], bks[2], (bank_n,) + shape),
+                    rid_bits)
+
+    @partial(jax.jit, static_argnames="k")
+    def chained(pa, bank_key, bank_pay, k):
+        def body(i, x):
+            peer = lww.PackedLWW(
+                key=jax.lax.dynamic_index_in_dim(bank_key, i % bank_n,
+                                                 keepdims=False),
+                payload=jax.lax.dynamic_index_in_dim(bank_pay, i % bank_n,
+                                                     keepdims=False),
+                rid_bits=x.rid_bits,
+            )
+            return lww.join_packed(x, peer)
+
+        out = jax.lax.fori_loop(0, k, body, pa)
+        return out.key.sum() + out.payload.sum()
+
+    ks_, kl = (8, 32) if tiny else (32, 256)
+    per = _timed(lambda k: int(chained(pa, bank.key, bank.payload, k)),
+                 ks_, kl, min_diff=0 if tiny else MIN_DIFF_S)
+    _emit(results, "lww_packed_replica_merges_per_sec_32m", r / per,
+          "replica-merges/s",
+          f"{r}-register packed-key argmax join (1 key + 1 payload plane)",
+          bytes_per_step=_hbm_bytes_per_step(2 * r * 4), sec_per_step=per)
 
 
 def _enable_compile_cache():
@@ -510,7 +571,8 @@ ALL = {
     "pncounter_vmap": bench_pncounter_vmap,
     "pncounter_1m": bench_pncounter_1m,
     "lww_argmax": bench_lww_argmax,
-    "lww_16m": bench_lww_16m,
+    "lww_32m": bench_lww_32m,
+    "lww_32m_packed": bench_lww_32m_packed,
     "orset_union": bench_orset_union,
     "orset_sweep": bench_orset_sweep,
     "orset_1m": bench_orset_1m,

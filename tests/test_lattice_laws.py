@@ -94,6 +94,55 @@ def test_lww_resolution_is_order_free():
     assert int(lww.value(reg)) == expected
 
 
+def test_lww_packed_roundtrip_and_equivalence():
+    """The packed fast path (key = ts << rid_bits | rid+1) must be an exact
+    order-preserving encoding: pack/unpack roundtrips bit-for-bit (incl.
+    negative ts and the unset sentinel), and unpack(join_packed(pack a,
+    pack b)) == join(a, b) — including exact (ts, rid) ties, where both
+    paths keep the left operand."""
+    rng = np.random.default_rng(11)
+    for _ in range(N_TRIALS):
+        a, b = helpers.rand_lww(rng, (64,)), helpers.rand_lww(rng, (64,))
+        assert tree_equal(lww.unpack(lww.pack(a)), a)
+        got = lww.unpack(lww.join_packed(lww.pack(a), lww.pack(b)))
+        assert tree_equal(got, lww.join(a, b))
+    # sentinel roundtrip + identity
+    z = lww.zero((4,))
+    assert tree_equal(lww.unpack(lww.pack(z)), z)
+    a = helpers.rand_lww(rng, (4,))
+    assert tree_equal(
+        lww.unpack(lww.join_packed(lww.pack(a), lww.pack(z))), a)
+    # exact (ts, rid) tie with different payloads: both paths keep LEFT
+    t = lww.LWWRegister(ts=np.int32([5]), rid=np.int32([2]),
+                        payload=np.int32([7]))
+    u = t.replace(payload=np.int32([9]))
+    assert int(lww.join(t, u).payload[0]) == 7
+    assert int(lww.unpack(lww.join_packed(lww.pack(t), lww.pack(u))).payload[0]) == 7
+
+
+def test_lww_packed_join_laws():
+    rng = np.random.default_rng(13)
+    for _ in range(N_TRIALS):
+        a, b, c = (lww.pack(helpers.rand_lww(rng)) for _ in range(3))
+        assert tree_equal(lww.join_packed(a, b), lww.join_packed(b, a))
+        assert tree_equal(lww.join_packed(lww.join_packed(a, b), c),
+                          lww.join_packed(a, lww.join_packed(b, c)))
+        assert tree_equal(lww.join_packed(a, a), a)
+        assert tree_equal(lww.join_packed(a, lww.pack(lww.zero())), a)
+
+
+def test_lww_pack_budget():
+    ok = helpers.rand_lww(np.random.default_rng(17), (8,))
+    assert bool(lww.pack_budget_ok(ok))
+    big_ts = ok.replace(ts=np.full(8, 1 << 28, np.int32))
+    assert not bool(lww.pack_budget_ok(big_ts))  # overflows ts << 6
+    big_rid = ok.replace(rid=np.full(8, 63, np.int32))
+    assert not bool(lww.pack_budget_ok(big_rid))  # rid+1 needs 7 bits
+    assert bool(lww.pack_budget_ok(big_rid, rid_bits=7))
+    neg_rid = ok.replace(rid=np.full(8, -2, np.int32))
+    assert not bool(lww.pack_budget_ok(neg_rid))
+
+
 def test_orset_add_remove_readd():
     s = orset.empty(16)
     s = orset.add(s, elem=3, rid=0, seq=0)
