@@ -377,21 +377,7 @@ def _make_lexn_union_kernel(n_keys: int, n_vals: int):
             jnp.concatenate([a[:], b[:]], axis=0) for a, b in zip(ka, kbr)
         ] + [jnp.concatenate([a[:], b[:]], axis=0) for a, b in zip(va, vb)]
         planes = _merge_stages_planes(planes, n, n_keys=n_keys)
-        keys, vals = planes[:n_keys], planes[n_keys:]
-
-        # duplicate punch (one-row lookback: inputs have unique keys, so
-        # each key occurs at most twice in the merged columns).  The
-        # punched copy's values OR into the kept copy first (see above).
-        dup = keys[0] != SENTINEL
-        for k in keys:
-            dup = dup & (k == _shift_down(k, 1, SENTINEL))
-        # masks shift as int32: Mosaic cannot concatenate i1 vregs
-        next_dup = _shift_up(dup.astype(jnp.int32), 1, 0) != 0
-        vals = [
-            jnp.where(next_dup, v | _shift_up(v, 1, 0), v) for v in vals
-        ]
-        keys = [jnp.where(dup, SENTINEL, k) for k in keys]
-        vals = [jnp.where(dup, 0, v) for v in vals]
+        keys, vals = _lexn_dup_punch(planes[:n_keys], planes[n_keys:])
 
         keys, vals, nu_row = _hole_compact(keys, vals, n)
         nu_ref[:] = nu_row
@@ -401,6 +387,26 @@ def _make_lexn_union_kernel(n_keys: int, n_vals: int):
             ref[:] = v[:out_rows]
 
     return kernel
+
+
+def _lexn_dup_punch(keys, vals):
+    """The lexN duplicate rule over globally sorted columns, shared by the
+    fused union kernel, the compaction-only kernel, and the XLA sort
+    epilogue (one implementation so the three epilogue programs cannot
+    drift apart): a one-row lookback finds duplicate rows (inputs have
+    unique keys, so each key occurs at most twice in a merged column),
+    the punched copy's values OR into the kept copy FIRST
+    (OR-combine-then-keep-first), then the dup row's keys become SENTINEL
+    and its values 0.  The dup mask shifts as int32 — Mosaic cannot
+    concatenate i1 vregs — which is equally correct under XLA."""
+    dup = keys[0] != SENTINEL
+    for k in keys:
+        dup = dup & (k == _shift_down(k, 1, SENTINEL))
+    next_dup = _shift_up(dup.astype(jnp.int32), 1, 0) != 0
+    vals = [jnp.where(next_dup, v | _shift_up(v, 1, 0), v) for v in vals]
+    keys = [jnp.where(dup, SENTINEL, k) for k in keys]
+    vals = [jnp.where(dup, 0, v) for v in vals]
+    return keys, vals
 
 
 @partial(jax.jit, static_argnames=("out_size", "interpret"))
@@ -540,6 +546,73 @@ def lexn_merge_columnar(keys_a, vals_a, keys_b, vals_b, interpret=False):
     return tuple(outs[:n_keys]), tuple(outs[n_keys:])
 
 
+def _make_lexn_compact_kernel(n_keys: int, n_vals: int):
+    """Dup-punch + hole-compaction ONLY: the striped union's epilogue as a
+    Pallas kernel — the exact tail of the fused lexN union kernel
+    (OR-combine-then-keep-first punch, then the `_hole_compact` log-step
+    network) with no merge network in front.  Far fewer live temporaries
+    than the monolith (no compare-exchange stages), so it fits VMEM at
+    2C row counts where the full union kernel OOMs; the round-5 split
+    measurement (PERF.md) showed the XLA sort epilogue was 60-70% of the
+    striped round, and the two XLA-level replacements both measured
+    SLOWER — the network only wins inside VMEM, which is this kernel."""
+
+    def kernel(*refs):
+        n_planes = n_keys + n_vals
+        ins, outs = refs[:n_planes], refs[n_planes:]
+        keys = [r[:] for r in ins[:n_keys]]
+        vals = [r[:] for r in ins[n_keys:]]
+        n = keys[0].shape[0]
+        out_rows = outs[0].shape[0]
+
+        keys, vals = _lexn_dup_punch(keys, vals)
+        keys, vals, nu_row = _hole_compact(keys, vals, n)
+        outs[-1][:] = nu_row
+        for ref, k in zip(outs[:n_keys], keys):
+            ref[:] = k[:out_rows]
+        for ref, v in zip(outs[n_keys:-1], vals):
+            ref[:] = v[:out_rows]
+
+    return kernel
+
+
+def lexn_compact_columnar(keys, vals, out_size: int, interpret=False):
+    """Columnar batched dedup + compaction over globally sorted (2C, L)
+    planes: punch adjacent duplicate rows (OR-combine-then-keep-first,
+    the lexN union's duplicate rule), sink the holes with the in-VMEM
+    log-step compaction network, truncate to ``out_size`` rows.  Returns
+    (keys_tuple, vals_tuple, n_unique[L]), n_unique pre-truncation."""
+    n_keys, n_vals = len(keys), len(vals)
+    n, lanes = keys[0].shape
+    assert n & (n - 1) == 0, f"row count {n} must be a power of two"
+    assert lanes % LANES == 0, (
+        f"lane count {lanes} must be a multiple of {LANES}"
+    )
+    grid = (lanes // LANES,)
+    in_spec = pl.BlockSpec((n, LANES), lambda i: (0, i))
+    out_spec = pl.BlockSpec((out_size, LANES), lambda i: (0, i))
+    nu_spec = pl.BlockSpec((1, LANES), lambda i: (0, i))
+    n_planes = n_keys + n_vals
+    outs = pl.pallas_call(
+        _make_lexn_compact_kernel(n_keys, n_vals),
+        grid=grid,
+        in_specs=[in_spec] * n_planes,
+        out_specs=[out_spec] * n_planes + [nu_spec],
+        out_shape=[jax.ShapeDtypeStruct((out_size, lanes), jnp.int32)]
+        * n_planes
+        + [jax.ShapeDtypeStruct((1, lanes), jnp.int32)],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=120 << 20,
+        ),
+    )(*keys, *vals)
+    return (
+        tuple(outs[:n_keys]),
+        tuple(outs[n_keys:n_planes]),
+        outs[n_planes][0],
+    )
+
+
 # The fused lexN kernel's measured VMEM envelope on v5e (PERF.md "where the
 # full-depth kernel's own ceiling is"): D=6 joins at C=256 fit; C=512
 # reports "129.60M of 128.00M".  Counting each call's planes + 1
@@ -548,6 +621,19 @@ def lexn_merge_columnar(keys_a, vals_a, keys_b, vals_b, interpret=False):
 # (planes+1) x C product <= 9216 keeps every known-good shape and excludes
 # the known-bad one.
 LEXN_PLANE_ROW_BUDGET = 9216
+
+# The compaction-only kernel's envelope: no merge network, so its live set
+# is roughly the planes themselves + the shifted candidates.  Measured on
+# v5e: (planes+1) x 2C = 22 x 2048 = 45056 (C=1024, D=6) compiles and runs;
+# the budget below admits it with headroom to the next pow2 shape and is
+# re-fitted the day a larger shape reports OOM (loudly, like the monolith).
+LEXN_COMPACT_PLANE_ROW_BUDGET = 45056
+
+
+def lexn_compact_fits(n_rows: int, n_planes: int) -> bool:
+    """Whether one compaction-only lexN pallas_call over ``n_rows``-row
+    planes (= 2C for a union epilogue) fits the v5e VMEM envelope."""
+    return n_rows * (n_planes + 1) <= LEXN_COMPACT_PLANE_ROW_BUDGET
 
 
 def lexn_fits(c: int, n_planes: int) -> bool:
@@ -571,6 +657,7 @@ def sorted_union_columnar_striped_lexn(
     out_size: int | None = None,
     stripe: int | None = None,
     interpret: bool = False,
+    epilogue: str = "auto",
 ):
     """Capacity-STRIPED fused lexN union (round-4 verdict task 2): the same
     contract as :func:`sorted_union_columnar_fused_lexn` at capacities
@@ -590,12 +677,20 @@ def sorted_union_columnar_striped_lexn(
          every call the same compiled program.  The primitive preserves
          the exact multiset, so block-network correctness is the scalar
          bitonic-merge theorem verbatim (no dedup-interaction caveats);
-      3. ONE XLA epilogue over the sorted (2C, L) planes: adjacent
-         duplicate punch (each key appears at most twice — operand lanes
-         have unique keys) with OR-combine-then-keep-first, then a
-         single-key stable sort on the hole flag — kept rows are already
-         key-ordered, so the 1-key sort just sinks holes to the tail —
-         then the ``out_size`` truncation.
+      3. ONE epilogue over the sorted (2C, L) planes: adjacent duplicate
+         punch (each key appears at most twice — operand lanes have
+         unique keys) with OR-combine-then-keep-first, hole compaction,
+         then the ``out_size`` truncation.  Two bit-identical epilogue
+         programs exist, selected by ``epilogue``: ``"kernel"`` — the
+         compaction-only Pallas kernel (:func:`lexn_compact_columnar`,
+         the in-VMEM log-step network; round-5 measurement made this the
+         compiled default after the XLA sort was measured at 60-70% of
+         the whole round); ``"sort"`` — the 21-operand single-key stable
+         XLA sort (the interpret/CPU path, and the silent-but-correct
+         fallback when the compact kernel's VMEM envelope is exceeded —
+         a loud Mosaic OOM only happens when ``"kernel"`` is forced);
+         ``"auto"`` — kernel when compiled AND :func:`lexn_compact_fits`,
+         else sort.
 
     Returns (keys_tuple, vals_tuple, n_unique[L]); n_unique is computed
     pre-truncation, so overflow (n_unique > out_size) stays detectable."""
@@ -609,6 +704,11 @@ def sorted_union_columnar_striped_lexn(
     )
     out = out_size if out_size is not None else 2 * c
     assert out <= 2 * c, f"out_size {out} exceeds the 2C={2*c} union bound"
+    assert epilogue in ("auto", "kernel", "sort"), epilogue
+    if epilogue == "auto":
+        use_kernel = (not interpret) and lexn_compact_fits(2 * c, n_planes)
+    else:
+        use_kernel = epilogue == "kernel"
 
     def rows(planes, lo, hi):
         return tuple(p[lo:hi] for p in planes)
@@ -643,14 +743,12 @@ def sorted_union_columnar_striped_lexn(
     vals = [jnp.concatenate([b[1][i] for b in blocks], axis=0)
             for i in range(n_vals)]
 
+    if use_kernel:
+        # compaction-only Pallas kernel: punch + in-VMEM log-step network
+        return lexn_compact_columnar(keys, vals, out, interpret=interpret)
+
     # XLA epilogue: dup punch + 1-key compaction sort + truncation
-    dup = keys[0] != SENTINEL
-    for k in keys:
-        dup = dup & (k == _shift_down(k, 1, SENTINEL))
-    next_dup = _shift_up(dup, 1, False)
-    vals = [jnp.where(next_dup, v | _shift_up(v, 1, 0), v) for v in vals]
-    keys = [jnp.where(dup, SENTINEL, k) for k in keys]
-    vals = [jnp.where(dup, 0, v) for v in vals]
+    keys, vals = _lexn_dup_punch(keys, vals)
     hole = keys[0] == SENTINEL
     sorted_planes = jax.lax.sort(
         [hole.astype(jnp.int32)] + keys + vals,

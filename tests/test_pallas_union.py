@@ -266,6 +266,47 @@ def test_striped_lexn_matches_fused(stripe):
             np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
 
 
+@pytest.mark.parametrize("stripe", [8, 32, 64])
+def test_striped_kernel_epilogue_matches_sort(stripe):
+    """Round-5: the compaction-only Pallas kernel epilogue
+    (lexn_compact_columnar — the compiled default on TPU) must be
+    bit-identical to the XLA sort epilogue AND to the fused monolith,
+    including at truncating out sizes and with heavy duplication."""
+    rng = np.random.default_rng(80 + stripe)
+    c, lanes, n_keys, n_vals = 64, 128, 3, 2
+    ka, va = _lexn_cols(rng, c, lanes, n_keys, n_vals, or_plane=1)
+    kb, vb = _lexn_cols(rng, c, lanes, n_keys, n_vals, or_plane=1)
+    for out_size in (None, c):
+        want = pallas_union.sorted_union_columnar_striped_lexn(
+            tuple(ka), tuple(va), tuple(kb), tuple(vb),
+            out_size=out_size, stripe=stripe, interpret=True,
+            epilogue="sort",
+        )
+        got = pallas_union.sorted_union_columnar_striped_lexn(
+            tuple(ka), tuple(va), tuple(kb), tuple(vb),
+            out_size=out_size, stripe=stripe, interpret=True,
+            epilogue="kernel",
+        )
+        oracle = pallas_union.sorted_union_columnar_fused_lexn(
+            tuple(ka), tuple(va), tuple(kb), tuple(vb),
+            out_size=out_size, interpret=True,
+        )
+        for w, g, o in zip(want[0] + want[1] + (want[2],),
+                           got[0] + got[1] + (got[2],),
+                           oracle[0] + oracle[1] + (oracle[2],)):
+            np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+            np.testing.assert_array_equal(np.asarray(o), np.asarray(g))
+
+
+def test_lexn_compact_fits_envelope():
+    """The compact kernel's envelope admits the production full-depth
+    shapes (2C=2048 x 22 planes at C=1024 x D=6) and excludes the next
+    doubling; auto epilogue dispatch keys off it."""
+    assert pallas_union.lexn_compact_fits(2048, 21)   # C=1024, D=6
+    assert pallas_union.lexn_compact_fits(1024, 21)   # C=512, D=6
+    assert not pallas_union.lexn_compact_fits(4096, 21)  # C=2048: sort path
+
+
 def test_lexn_auto_dispatch():
     """The auto entry point picks the monolith inside the VMEM envelope
     and the striped path beyond it, transparently to callers."""
