@@ -3,7 +3,10 @@ real chip (CI runs them interpret-mode on CPU only — Mosaic lowering
 differences are exactly what interpret mode cannot catch; the workarounds
 in ops/pallas_union.py exist because of such differences).
 
-Checks, each against the generic XLA sorted_union on the same data:
+Checks, each against an independent oracle on the same data (the generic
+XLA sorted_union for most; check 6's oracle is the fused monolith in
+interpret mode, itself pinned to the generic path by checks 1-5 and the
+CI suite):
 
   1. OR-combine fused union (sorted_union_columnar) at C=64 and C=1024;
   2. lex2 keep-first fused union (the OpLog path) incl. n_unique;
@@ -11,8 +14,11 @@ Checks, each against the generic XLA sorted_union on the same data:
   4. sharded_converge on a 1-device mesh (compiled Mosaic under shard_map);
   5. lexN (18-key-word) fused union: columnar RSeq merge vs the vmapped
      generic 24-column join, incl. the tombstone OR-on-punch rule;
-  6. GC-aware columnar RSeq join (rseq_engine) vs the generic tomb_gc
-     join, with diverged per-lane floors.
+  6. capacity-striped union with the compact-kernel epilogue forced
+     (the round-5 compiled epilogue) vs the fused monolith oracle;
+  7. GC-aware columnar RSeq join (rseq_engine) vs the generic tomb_gc
+     join, with diverged per-lane floors;
+  8. sharded GC-aware converge under shard_map.
 
 Run after ANY kernel change:  python benches/hw_selftest.py
 Exit code 0 = all green.  ~1 min of compiles on a tunnel-attached chip.
@@ -173,6 +179,35 @@ def check_lexn_rseq():
     _log("  lexN RSeq union (18 key words): OK")
 
 
+def check_striped_epilogue():
+    """The capacity-striped union with the round-5 compaction-only kernel
+    epilogue FORCED (the compiled production epilogue above the monolith's
+    VMEM envelope), vs the fused monolith interpret oracle — small shapes,
+    so the check is cheap while still compiling both the merge-only and
+    compact kernels through Mosaic."""
+    from benches.bench_rseq_columnar import make_swarm_planes
+
+    col = make_swarm_planes(13, 64, 256, depth=6)
+    nk = col.keys.shape[0]
+    a = jax.tree.map(lambda x: x[..., :128], col)
+    b = jax.tree.map(lambda x: x[..., 128:], col)
+    ka = tuple(a.keys[i] for i in range(nk))
+    kb = tuple(b.keys[i] for i in range(nk))
+    va, vb = (a.elem, a.removed), (b.elem, b.removed)
+    interpret = jax.default_backend() != "tpu"
+    got = pallas_union.sorted_union_columnar_striped_lexn(
+        ka, va, kb, vb, out_size=64, stripe=16,
+        interpret=interpret, epilogue="kernel",
+    )
+    want = pallas_union.sorted_union_columnar_fused_lexn(
+        ka, va, kb, vb, out_size=64, interpret=True,
+    )
+    for g, w in zip(got[0] + got[1] + (got[2],),
+                    want[0] + want[1] + (want[2],)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    _log("  striped union, compact-kernel epilogue: OK")
+
+
 def check_gc_rseq():
     """The GC-aware columnar RSeq join (rseq_engine.gc_merge_checked —
     fused lexN union + floor suppression + 1-key compaction) COMPILED on
@@ -278,6 +313,7 @@ def run(full=True, log=print):
         check_columnar_oplog()
         check_sharded()
         check_lexn_rseq()
+        check_striped_epilogue()
         check_gc_rseq()
         check_sharded_gc()
         log("hw_selftest: ALL OK")
