@@ -18,7 +18,12 @@ Measurement notes (both matter on this tunnel-attached chip):
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-   "p50_merge_latency_us": N, "p99_merge_latency_us": N, "latency_samples": N}
+   "p50_merge_latency_us": N, "p99_merge_latency_us": N,
+   "latency_samples": N, "obs": {...}}
+The "obs" key is the run's registry snapshot (crdt_tpu.obs): the latency
+samples also stream through a mergeable log2-bucket histogram, so the
+driver can fold many runs' histograms elementwise instead of re-deriving
+quantiles from raw sample lists.
 vs_baseline is value / 100e6 (the BASELINE target; the reference publishes
 no numbers of its own — BASELINE.md "published: none").  The latency
 quantiles answer the second half of the north-star metric ("p50 merge
@@ -157,6 +162,15 @@ def main():
     p50 = _quantile(per_merge_samples, 0.50)
     p99 = _quantile(per_merge_samples, 0.99)
 
+    # end-of-run registry snapshot: the same samples through the mergeable
+    # histogram (crdt_tpu.obs) — fold-able across runs by the driver
+    from crdt_tpu.obs.registry import MetricsRegistry
+
+    obs = MetricsRegistry()
+    for s in per_merge_samples:
+        obs.observe("merge", s)
+    obs.inc("bench_runs")
+
     merges_per_sec = R / p50
     print(
         json.dumps(
@@ -168,6 +182,7 @@ def main():
                 "p50_merge_latency_us": round(p50 * 1e6, 3),
                 "p99_merge_latency_us": round(p99 * 1e6, 3),
                 "latency_samples": len(per_merge_samples),
+                "obs": {k: round(v, 6) for k, v in obs.snapshot().items()},
             }
         )
     )

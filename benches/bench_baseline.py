@@ -94,6 +94,14 @@ def _timed(fn, k_small, k_large, reps=REPS, min_diff=MIN_DIFF_S):
     return max((t2 - t1) / (k_large - k_small), 1e-12)
 
 
+# end-of-run observability snapshot (crdt_tpu.obs): every emitted row
+# counts, and measured step times feed a mergeable histogram — the suite's
+# own telemetry rides the same registry the nodes expose on GET /metrics
+from crdt_tpu.obs.registry import MetricsRegistry
+
+OBS = MetricsRegistry()
+
+
 def _emit(results, name, value, unit, note, bytes_per_step=None,
           sec_per_step=None, traffic_kind="hbm"):
     """One JSON line per config.  When the caller supplies its per-step
@@ -114,6 +122,9 @@ def _emit(results, name, value, unit, note, bytes_per_step=None,
         line["traffic_kind"] = traffic_kind
     print(json.dumps(line), flush=True)
     results.append(line)
+    OBS.inc("bench_rows")
+    if sec_per_step:
+        OBS.observe("bench_step", sec_per_step)
 
 
 # ---- configs ----------------------------------------------------------------
@@ -662,7 +673,11 @@ def _run_isolated(names, args):
             line = line.strip()
             if line.startswith("{"):
                 print(line, flush=True)
-                results.append(json.loads(line))
+                row = json.loads(line)
+                # each child emits its own end-of-run snapshot; keep them
+                # out of the aggregated result table (and BENCH_TABLE.md)
+                if row.get("metric") != "obs_snapshot":
+                    results.append(row)
     return results
 
 
@@ -699,6 +714,13 @@ def main():
                 fn(results, args.tiny)
     if args.write_md:
         write_md(results, REPO / "BENCH_TABLE.md")
+    # end-of-run registry snapshot: row count + step-time histogram summary,
+    # one JSON line in the same shape as the result rows
+    print(json.dumps({
+        "metric": "obs_snapshot", "value": float(len(results)),
+        "unit": "rows", "note": "end-of-run metrics snapshot",
+        "obs": {k: round(v, 6) for k, v in OBS.snapshot().items()},
+    }), flush=True)
 
 
 if __name__ == "__main__":

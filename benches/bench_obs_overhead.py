@@ -1,0 +1,92 @@
+"""Instrumentation overhead A/B: pull rounds with the real metrics
+registry vs the no-op NullRegistry (crdt_tpu.obs).
+
+The observability layer rides every gossip round (counters, the lag
+gauges, an event-log line, a trace span), so its cost must stay in the
+noise against the round's real work (payload build + receive/merge).
+Acceptance bar (ISSUE: unified telemetry layer): <= 5% overhead on this
+in-process pull-round microbench.
+
+Protocol: one writer node, one puller; each round appends one command and
+pulls it over (delta gossip, the hot deployment mode).  Configs run
+interleaved A/B/A/B over several blocks so clock drift and jit-cache
+warmth cancel; the reported overhead compares per-round medians.
+
+Run:  JAX_PLATFORMS=cpu python benches/bench_obs_overhead.py [--rounds N]
+Emits one JSON line, same shape as benches/bench_baseline.py rows.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import statistics
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def _run_block(n_rounds: int, registry) -> float:
+    """Seconds for n_rounds write+pull rounds against a fresh node pair."""
+    from crdt_tpu.api.node import ReplicaNode, pull_round
+    from crdt_tpu.obs.trace import mint_trace_id
+    from crdt_tpu.utils.clock import HostClock
+    from crdt_tpu.utils.metrics import Metrics
+
+    clock = HostClock()
+    metrics = Metrics(registry=registry)
+    writer = ReplicaNode(rid=0, clock=clock, metrics=metrics)
+    puller = ReplicaNode(rid=1, clock=clock, metrics=metrics)
+    # warm the jit caches outside the timed region
+    writer.add_command({"warm": "1"})
+    pull_round(puller, writer.gossip_payload, metrics, delta=True,
+               peer="0", trace=mint_trace_id(1))
+    t0 = time.perf_counter()
+    for i in range(n_rounds):
+        writer.add_command({f"k{i % 8}": str(i)})
+        pull_round(
+            puller, writer.gossip_payload, metrics, delta=True,
+            peer="0", trace=mint_trace_id(1),
+        )
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=150,
+                    help="pull rounds per block")
+    ap.add_argument("--blocks", type=int, default=5,
+                    help="interleaved A/B blocks per config")
+    args = ap.parse_args()
+
+    from crdt_tpu.obs.registry import NULL_REGISTRY, MetricsRegistry
+
+    real, null = [], []
+    for _ in range(args.blocks):
+        real.append(_run_block(args.rounds, MetricsRegistry()))
+        null.append(_run_block(args.rounds, NULL_REGISTRY))
+    t_real = statistics.median(real) / args.rounds
+    t_null = statistics.median(null) / args.rounds
+    overhead_pct = 100.0 * (t_real - t_null) / t_null
+    line = {
+        "metric": "obs_overhead_pull_round",
+        "value": round(overhead_pct, 2),
+        "unit": "%",
+        "vs_baseline": None,
+        "note": (
+            f"metrics-enabled vs no-op registry over "
+            f"{args.blocks}x{args.rounds} interleaved pull rounds "
+            f"({t_real * 1e6:.1f}us vs {t_null * 1e6:.1f}us/round); "
+            f"acceptance <= 5%: "
+            f"{'PASS' if overhead_pct <= 5.0 else 'FAIL'}"
+        ),
+        "us_per_round_real": round(t_real * 1e6, 2),
+        "us_per_round_null": round(t_null * 1e6, 2),
+    }
+    print(json.dumps(line), flush=True)
+    return 0 if overhead_pct <= 5.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -225,6 +225,7 @@ def run_daemon(args) -> int:
         coordinator=args.coordinator,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every_s=args.checkpoint_every_s,
+        event_log=args.event_log,
     )
     host.start()
     # pre-compile the sequence lattice's device paths in the background:
@@ -340,6 +341,11 @@ def main(argv=None) -> int:
     ap.add_argument("--rid-stride", type=int, default=64,
                     help="daemon: writer-id stride between boot "
                          "incarnations of one checkpoint dir")
+    ap.add_argument("--event-log", type=str, default=None,
+                    help="daemon: JSONL event-log path (one line per "
+                         "gossip round / barrier / fault transition, "
+                         "carrying the round's X-CRDT-Trace ID — the "
+                         "forensic black box the crash soak reads back)")
     ap.add_argument("--platform", choices=["cpu", "tpu", "ambient"],
                     default="cpu",
                     help="JAX backend for the host runtime (default cpu: "

@@ -17,6 +17,7 @@ import threading
 from typing import Dict, List, Optional
 
 from crdt_tpu.api.node import ReplicaNode, pull_round, stable_frontier_host
+from crdt_tpu.obs.trace import mint_trace_id
 from crdt_tpu.utils.clock import HostClock
 from crdt_tpu.utils.config import ClusterConfig
 from crdt_tpu.utils.metrics import Metrics
@@ -99,11 +100,24 @@ class LocalCluster:
         if peer is None or peer is node or not peer.alive:
             self.metrics.inc("gossip_skipped")
             return False
+        tid = mint_trace_id(node.rid)
+
+        def fetch(since):
+            payload = peer.gossip_payload(since=since)
+            if payload is not None:
+                # in-process serve side of the round (the HTTP shim's
+                # gossip_serve analogue): same trace ID on both event logs
+                peer.events.emit("gossip_serve", trace=tid,
+                                 peer=str(node.rid), delta=since is not None)
+            return payload
+
         merged = pull_round(
             node,
-            lambda since: peer.gossip_payload(since=since),
+            fetch,
             self.metrics,
             delta=self.config.delta_gossip,
+            peer=str(peer.rid),
+            trace=tid,
         )
         # set-lattice pull riding the same round (KV result returned —
         # the surfaces' freshness is never conflated, api/net.py rule)

@@ -13,6 +13,14 @@ compaction barrier, crdt_tpu.api.net.network_compact):
   GET  /vv                      {"vv": {rid: seq}, "frontier": {rid: seq}}
   POST /compact                 {"frontier": {rid: seq}} -> fold + prune
 
+Observability (crdt_tpu.obs):
+  GET  /metrics                 Prometheus text exposition (counters,
+                                gauges, latency histograms + the lattice
+                                health gauges sampled at scrape time)
+  GET  /gossip with an X-CRDT-Trace header records a gossip_serve event
+  under the puller's trace ID in this node's event log and echoes the
+  header back — one trace ID names the round on both ends of the wire.
+
 Daemon admin extensions (present only when the handler is built with an
 ``admin`` object — a NodeHost; used by the crash soak to drive a daemon
 fleet deterministically, crdt_tpu.harness.crashsoak):
@@ -65,6 +73,10 @@ from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from crdt_tpu.api.cluster import LocalCluster
+from crdt_tpu.obs import health
+from crdt_tpu.obs.trace import TRACE_HEADER
+
+PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def _make_handler(cluster: LocalCluster, idx: int, admin=None):
@@ -80,10 +92,13 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
         def _send(self, code: int, body: str, ctype: str = "text/plain"):
             self._send_bytes(code, body.encode(), ctype)
 
-        def _send_bytes(self, code: int, data: bytes, ctype: str):
+        def _send_bytes(self, code: int, data: bytes, ctype: str,
+                        extra_headers=None):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -223,7 +238,16 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 else:
                     self._send(404, "not found")
                 return
-            if url.path == "/ping":
+            if url.path == "/metrics":
+                # Prometheus text exposition: the node's whole registry +
+                # the lattice health gauges, sampled at scrape time (the
+                # gauges are always scrape-fresh; an idle node pays zero)
+                body = health.render_node_metrics(
+                    self.node, set_node=self.set_node,
+                    seq_node=self.seq_node, map_node=self.map_node,
+                )
+                self._send(200, body, PROM_CTYPE)
+            elif url.path == "/ping":
                 if self.node.ping():
                     self._send(200, "Pong")
                 else:
@@ -251,11 +275,23 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     except Exception:
                         self._send(400, "invalid vv")
                         return
+                trace = self.headers.get(TRACE_HEADER)
                 body = self.node.gossip_payload_json(since=since)
                 if body is None:
                     self._send(502, "Unreachable")
-                else:
-                    self._send_bytes(200, body, "application/json")
+                    return
+                if trace:
+                    # the serve side of the round: same trace ID as the
+                    # puller's pull_* events — grep one ID, see both ends
+                    self.node.events.emit(
+                        "gossip_serve", trace=trace,
+                        peer=self.client_address[0], delta=since is not None,
+                        bytes=len(body),
+                    )
+                self._send_bytes(
+                    200, body, "application/json",
+                    extra_headers={TRACE_HEADER: trace} if trace else None,
+                )
             elif url.path == "/vv":
                 if not self.node.alive:
                     self._send(502, "Unreachable")

@@ -33,6 +33,8 @@ from http.server import ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from crdt_tpu.api.node import ReplicaNode, pull_round, stable_frontier_host
+from crdt_tpu.obs.events import EventLog
+from crdt_tpu.obs.trace import TRACE_HEADER, mint_trace_id
 from crdt_tpu.utils.config import ClusterConfig
 from crdt_tpu.utils.metrics import Metrics
 
@@ -51,11 +53,11 @@ class RemotePeer:
         self.serves_seq: Optional[bool] = None  # same, for /seq/gossip
         self.serves_map: Optional[bool] = None  # same, for /map/gossip
 
-    def _get(self, path: str) -> Optional[bytes]:
+    def _get(self, path: str,
+             headers: Optional[Dict[str, str]] = None) -> Optional[bytes]:
+        req = urllib.request.Request(self.url + path, headers=headers or {})
         try:
-            with urllib.request.urlopen(
-                self.url + path, timeout=self.timeout
-            ) as res:
+            with urllib.request.urlopen(req, timeout=self.timeout) as res:
                 return res.read() if res.status == 200 else None
         except (urllib.error.URLError, OSError):
             return None  # unreachable/dead peer: caller skips (main.go:235-239)
@@ -102,15 +104,19 @@ class RemotePeer:
         return self._parse(self._get("/data"))
 
     def gossip_payload(
-        self, since: Optional[Dict[int, int]] = None
+        self, since: Optional[Dict[int, int]] = None,
+        trace: Optional[str] = None,
     ) -> Optional[Dict[str, Any]]:
         """GET /gossip (main.go:154-171); ``since`` = our version vector for
-        delta gossip (?vv=...), None requests the full-log dump."""
+        delta gossip (?vv=...), None requests the full-log dump.  ``trace``
+        rides the X-CRDT-Trace header so the serving node's event log
+        records the round under the puller's trace ID."""
         path = "/gossip"
         if since is not None:
             vv = json.dumps({str(r): s for r, s in since.items()})
             path += "?vv=" + urllib.parse.quote(vv)
-        return self._parse(self._get(path))
+        headers = {TRACE_HEADER: trace} if trace else None
+        return self._parse(self._get(path, headers=headers))
 
     def add_command(self, cmd: Dict[str, str]) -> bool:
         """POST /data (main.go:173-215)."""
@@ -340,12 +346,15 @@ class NetworkAgent:
             self.metrics.inc("net_gossip_skipped")
             return False
         peer = self._rng.choice(self.peers)
+        tid = mint_trace_id(self.node.rid)
         merged = pull_round(
             self.node,
-            peer.gossip_payload,
+            lambda since: peer.gossip_payload(since, trace=tid),
             self.metrics,
             delta=self.config.delta_gossip,
             prefix="net_gossip",
+            peer=peer.url,
+            trace=tid,
         )
         self.set_pull(peer)
         self.seq_pull(peer)
@@ -579,6 +588,7 @@ class NodeHost:
         coordinator: bool = False,
         checkpoint_dir: Optional[str] = None,
         checkpoint_every_s: float = 0,
+        event_log: Optional[str] = None,
     ):
         from crdt_tpu.api.http_shim import _make_handler
         from crdt_tpu.api.mapnode import MapNode
@@ -597,9 +607,13 @@ class NodeHost:
                 "peers: a full pull would receive the lossy bare-ms dump "
                 "(rid-less foreign ops) meant for Go peers only"
             )
+        # event_log: JSONL file sink path — each gossip round / barrier /
+        # fault transition appends one line (the daemon's black box; the
+        # crash soak points this at the checkpoint dir)
         self.node = ReplicaNode(
             rid=rid, capacity=capacity or self.config.log_capacity,
             go_compat_gossip=self.config.go_compat_gossip,
+            events=EventLog(node=str(rid), path=event_log),
         )
         # the set-lattice sibling: same wire rid (namespaces are disjoint —
         # set vv/floor never mix with the KV vv/frontier), gossiped and
@@ -638,6 +652,10 @@ class NodeHost:
         )
         self.port: int = self._server.server_address[1]
         self.url = f"http://{host}:{self.port}"
+        self.node.events.emit(
+            "boot", port=self.port, restored=self.restored,
+            coordinator=coordinator,
+        )
         self._server_thread: Optional[threading.Thread] = None
         self._ckpt_stop = threading.Event()
         self._ckpt_thread: Optional[threading.Thread] = None
@@ -669,6 +687,7 @@ class NodeHost:
             self._ckpt_thread.start()
 
     def stop(self) -> None:
+        self.node.events.emit("stop")
         try:
             self._ckpt_stop.set()
             if self._ckpt_thread is not None:
@@ -711,12 +730,16 @@ class NodeHost:
         configured peer) — deterministic external gossip drive."""
         if peer_url is None:
             return self.agent.gossip_once()
+        peer = RemotePeer(peer_url)
+        tid = mint_trace_id(self.node.rid)
         return pull_round(
             self.node,
-            RemotePeer(peer_url).gossip_payload,
+            lambda since: peer.gossip_payload(since, trace=tid),
             self.agent.metrics,
             delta=self.config.delta_gossip,
             prefix="net_gossip",
+            peer=peer.url,
+            trace=tid,
         )
 
     def admin_barrier(self) -> dict:

@@ -26,6 +26,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from crdt_tpu.models import compactlog, oplog
+from crdt_tpu.obs import health
+from crdt_tpu.obs.events import EventLog
+from crdt_tpu.obs.trace import current_trace, span
 from crdt_tpu.utils.clock import HostClock, SeqGen
 from crdt_tpu.utils.intern import Interner, encode_value
 from crdt_tpu.utils.metrics import Metrics
@@ -94,7 +97,8 @@ def stable_frontier_host(vvs, frontiers) -> Dict[int, int]:
 
 
 def pull_round(node: "ReplicaNode", fetch_payload, metrics, delta: bool,
-               prefix: str = "gossip") -> bool:
+               prefix: str = "gossip", peer: Optional[str] = None,
+               trace: Optional[str] = None) -> bool:
     """One anti-entropy pull into ``node`` — the shared round body of every
     gossip driver (in-process LocalCluster, cross-process NetworkAgent): ask
     the peer for a (delta) payload, merge it, and keep the skip/noop/fresh
@@ -102,28 +106,46 @@ def pull_round(node: "ReplicaNode", fetch_payload, metrics, delta: bool,
 
     ``fetch_payload(since)`` returns the peer's payload dict, or None for an
     unreachable/dead peer (the reference's 502-skip, main.go:235-239).
+
+    ``peer``/``trace`` feed the observability layer: the round's outcome is
+    emitted to ``node.events`` under the gossip round's trace ID, and the
+    delta-payload op count is recorded as the lag-behind-``peer`` gauge
+    (crdt_tpu.obs.health) — in delta mode that count IS how many ops this
+    node lacked.
     """
+    lab = str(node.rid)
     if not node.alive:
         metrics.inc(f"{prefix}_skipped")
+        node.events.emit("pull_skip", trace=trace, peer=peer, reason="down")
         return False
-    since = node.version_vector() if delta else None
-    payload = fetch_payload(since)
-    if payload is None:
-        metrics.inc(f"{prefix}_skipped")
-        return False
-    if not payload:  # delta mode: peer had nothing we lack — no merge
-        metrics.inc(f"{prefix}_noop")
-        return False
-    metrics.inc(
-        f"{prefix}_payload_ops",
-        sum(1 for k in payload if k not in (FRONTIER_KEY, SUMMARY_KEY)),
-    )
-    fresh = node.receive(payload)
-    if not fresh:  # payload was all re-deliveries (e.g. foreign ops)
-        metrics.inc(f"{prefix}_noop")
-        return False
-    metrics.inc(f"{prefix}_rounds")
-    return True
+    with span(f"crdt.pull_round.{prefix}", trace) as tid:
+        since = node.version_vector() if delta else None
+        payload = fetch_payload(since)
+        if payload is None:
+            metrics.inc(f"{prefix}_skipped")
+            node.events.emit("pull_skip", trace=tid, peer=peer,
+                             reason="peer_unreachable")
+            return False
+        n_ops = sum(
+            1 for k in payload if k not in (FRONTIER_KEY, SUMMARY_KEY)
+        )
+        if delta:
+            health.observe_pull_lag(metrics.registry, lab, peer or "?", n_ops)
+        if not payload:  # delta mode: peer had nothing we lack — no merge
+            metrics.inc(f"{prefix}_noop")
+            node.events.emit("pull_noop", trace=tid, peer=peer)
+            return False
+        metrics.inc(f"{prefix}_payload_ops", n_ops)
+        fresh = node.receive(payload)
+        if not fresh:  # payload was all re-deliveries (e.g. foreign ops)
+            metrics.inc(f"{prefix}_noop")
+            node.events.emit("pull_noop", trace=tid, peer=peer, ops=n_ops)
+            return False
+        metrics.inc(f"{prefix}_rounds")
+        health.mark_merge(metrics.registry, lab)
+        node.events.emit("pull_merge", trace=tid, peer=peer, ops=n_ops,
+                         fresh=fresh)
+        return True
 
 
 class ReplicaNode:
@@ -135,10 +157,14 @@ class ReplicaNode:
         metrics: Optional[Metrics] = None,
         use_native: Optional[bool] = None,
         go_compat_gossip: bool = False,
+        events: Optional[EventLog] = None,
     ):
         from crdt_tpu import native
 
         self.rid = rid
+        # per-node structured event log (bounded ring; NodeHost attaches a
+        # JSONL file sink for the cross-process forensic record)
+        self.events = events if events is not None else EventLog(node=str(rid))
         # Opt-in MIXED-FLEET mode (round-2 verdict, missing #1): emit
         # full-dump gossip with the reference's BARE integer-ms keys so an
         # original Go peer can pull from this node without its Atoi loop
@@ -410,7 +436,7 @@ class ReplicaNode:
                 )
             rows.append((ts, rid, seq, cmd))
         with self._lock:
-            with self.metrics.timer("merge"):
+            with self.metrics.timer("merge"), span("crdt.merge"):
                 adopted = 0
                 if remote_frontier:
                     adopted = self._adopt_frontier_locked(
@@ -461,16 +487,21 @@ class ReplicaNode:
             w = self._n_writers()
             merged = dict(self._frontier)
             merged.update(target)
-            folded = compactlog.compact(
-                self._device_clog(n_writers=w),
-                self._frontier_array(merged, w),
-            )
-            self.log = folded.tail
-            self._frontier = merged
-            self._summary = self._decode_summary(folded.summary)
-            self._summary_cache = (folded.summary, folded.summary.num.shape[-1])
-            self._prune_commands_locked()
-            self.metrics.inc("compactions")
+            with span("crdt.compact") as tid:
+                folded = compactlog.compact(
+                    self._device_clog(n_writers=w),
+                    self._frontier_array(merged, w),
+                )
+                self.log = folded.tail
+                self._frontier = merged
+                self._summary = self._decode_summary(folded.summary)
+                self._summary_cache = (
+                    folded.summary, folded.summary.num.shape[-1]
+                )
+                self._prune_commands_locked()
+                self.metrics.inc("compactions")
+                self.events.emit("compact", trace=tid,
+                                 frontier={str(r): s for r, s in merged.items()})
 
     def _adopt_frontier_locked(
         self, remote_frontier: Dict[int, int], remote_summary: Dict[str, Any]
@@ -521,6 +552,10 @@ class ReplicaNode:
         )
         self._prune_commands_locked()
         self.metrics.inc("frontier_adoptions")
+        self.events.emit(
+            "frontier_adopt", trace=current_trace(),
+            frontier={str(r): s for r, s in self._frontier.items()},
+        )
         return 1
 
     def _prune_commands_locked(self) -> None:

@@ -136,6 +136,10 @@ class Daemon:
         """The writer id of the CURRENT boot (matches bump_incarnation)."""
         return self.slot + RID_STRIDE * (self.boots - 1)
 
+    @property
+    def event_log_path(self) -> str:
+        return str(pathlib.Path(self.ckpt_dir) / "events.jsonl")
+
     def spawn(self, wait_s: float = 90.0) -> None:
         assert self.proc is None or self.proc.poll() is not None
         argv = [
@@ -145,6 +149,11 @@ class Daemon:
             "--checkpoint-dir", self.ckpt_dir,
             "--rid-stride", str(RID_STRIDE),
             "--gossip-ms", "600000",  # external drive only (determinism)
+            # per-slot black box: every boot of this slot appends to the
+            # same JSONL, so a SIGKILLed incarnation's last rounds are
+            # readable post-mortem (crdt_tpu.obs.events.read_jsonl
+            # tolerates the torn final line)
+            "--event-log", self.event_log_path,
         ]
         if self.coordinator:
             argv.append("--coordinator")
@@ -227,6 +236,8 @@ class CrashReport:
     map_ops_lost: int = 0
     map_peak_records: int = 0     # peak retained records between resets
     final_map_keys: int = 0
+    event_lines: int = 0          # JSONL black-box lines across all slots
+    event_boots: int = 0          # boot events logged (== fleet incarnations)
 
     def __str__(self) -> str:
         return (
@@ -249,7 +260,8 @@ class CrashReport:
             f"{self.map_barriers} resets (+{self.map_barriers_noop} noop, "
             f"{self.map_barriers_skipped} skipped), {self.map_ops_lost} "
             f"crash-lost, peak {self.map_peak_records} records, "
-            f"{self.final_map_keys} keys"
+            f"{self.final_map_keys} keys; black box: {self.event_lines} "
+            f"event lines / {self.event_boots} boots"
         )
 
 
@@ -1043,6 +1055,22 @@ class CrashSoakRunner:
             f"surviving-op fold: fleet={got_map_items} oracle={want_map}"
         )
         r.final_map_keys = len(got_map_items)
+
+        # forensic black box (crdt_tpu.obs.events): every slot's JSONL must
+        # have recorded the run — one boot line per incarnation (SIGKILLed
+        # boots included: the line is flushed at spawn), so a silent
+        # event-log regression fails the soak, not just the post-mortem.
+        from crdt_tpu.obs.events import read_jsonl
+
+        for d in self.daemons:
+            recs = read_jsonl(d.event_log_path)
+            r.event_lines += len(recs)
+            boots = sum(1 for e in recs if e.get("event") == "boot")
+            assert boots == d.boots, (
+                f"black box: slot {d.slot} logged {boots} boot events "
+                f"across {d.boots} boots (event log lost writes?)"
+            )
+            r.event_boots += boots
         return r
 
     def close(self) -> None:

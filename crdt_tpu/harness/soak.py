@@ -24,6 +24,7 @@ CI runs a short sweep (tests/test_soak.py).
 from __future__ import annotations
 
 import dataclasses
+import json
 import random
 import sys
 from typing import Dict, List, Optional
@@ -46,6 +47,9 @@ class SoakReport:
     barriers_skipped: int
     rounds_to_converge: int
     final_state: Dict[str, str]
+    # end-of-run registry snapshot (counters + latency summaries): machine-
+    # readable companion to __str__, carried into the CLI's JSON line
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @classmethod
     def zero(cls) -> "SoakReport":
@@ -206,6 +210,7 @@ class SoakRunner:
             f"diff={ {k: (want.get(k), got.get(k)) for k in set(want) | set(got) if want.get(k) != got.get(k)} }"
         )
         r.final_state = got
+        r.metrics = self.cluster.metrics.snapshot()
         return r
 
     def run(self, n_steps: int) -> SoakReport:
@@ -328,6 +333,7 @@ class NetworkSoakRunner:
         got = self.hosts[0].node.get_state()
         assert got == want, f"durability violated (I1): {got} != {want}"
         r.final_state = got
+        r.metrics = self.hosts[0].agent.metrics.snapshot()
         return r
 
     def run(self, n_steps: int) -> SoakReport:
@@ -372,17 +378,23 @@ def main(argv=None) -> int:
                 n=args.replicas, seed=seed,
                 config=ClusterConfig(delta_gossip=not args.full_gossip),
             )
-            print(f"seed {seed}: {runner.run(args.steps)}")
-            continue
-        runner = SoakRunner(
-            ClusterConfig(
-                n_replicas=args.replicas,
-                compact_every=args.compact_every,
-                delta_gossip=not args.full_gossip,
-            ),
-            seed=seed,
-        )
-        print(f"seed {seed}: {runner.run(args.steps)}")
+            report = runner.run(args.steps)
+        else:
+            runner = SoakRunner(
+                ClusterConfig(
+                    n_replicas=args.replicas,
+                    compact_every=args.compact_every,
+                    delta_gossip=not args.full_gossip,
+                ),
+                seed=seed,
+            )
+            report = runner.run(args.steps)
+        print(f"seed {seed}: {report}")
+        # machine-readable companion line (same shape as bench.py output)
+        print(json.dumps({
+            "seed": seed, "steps": report.steps,
+            "metrics": {k: round(v, 4) for k, v in report.metrics.items()},
+        }, sort_keys=True))
     return 0
 
 

@@ -1,0 +1,30 @@
+"""First-class observability: metrics registry + Prometheus exposition
+(crdt_tpu.obs.registry), cross-node gossip tracing (crdt_tpu.obs.trace),
+per-node JSONL event logs (crdt_tpu.obs.events), and lattice-aware
+replication-health gauges (crdt_tpu.obs.health).
+
+The host-facing ``Metrics`` class in crdt_tpu.utils.metrics is a thin
+shim over a ``MetricsRegistry``; every node surface (api/http_shim)
+serves ``GET /metrics`` in Prometheus text format.
+"""
+from crdt_tpu.obs.events import EventLog, read_jsonl
+from crdt_tpu.obs.registry import (
+    NULL_REGISTRY,
+    Histogram,
+    MetricsRegistry,
+    NullRegistry,
+)
+from crdt_tpu.obs.trace import TRACE_HEADER, current_trace, mint_trace_id, span
+
+__all__ = [
+    "EventLog",
+    "read_jsonl",
+    "Histogram",
+    "MetricsRegistry",
+    "NullRegistry",
+    "NULL_REGISTRY",
+    "TRACE_HEADER",
+    "current_trace",
+    "mint_trace_id",
+    "span",
+]

@@ -1,0 +1,98 @@
+"""Structured JSON-lines event log: the per-node forensic record.
+
+Every gossip round, barrier, and fault-relevant transition emits one event
+carrying the round's trace ID (crdt_tpu.obs.trace), so a cross-fleet
+incident reconstructs by grepping one ID across the nodes' JSONL files —
+the record the crash soak (crdt_tpu.harness.crashsoak) previously lacked:
+a SIGKILLed daemon's last appended lines ARE its black box.
+
+Events are kept in a bounded in-memory ring (cheap, always on) and,
+when a path is configured, appended to a JSONL file with a flush per
+line (crash-durability beats batching here; event rate is per-round, not
+per-op).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class EventLog:
+    """Thread-safe bounded event ring with an optional JSONL file sink."""
+
+    def __init__(self, node: str = "?", path: Optional[str] = None,
+                 capacity: int = 4096):
+        self.node = str(node)
+        self.path = path
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._fh = open(path, "a", encoding="utf-8") if path else None
+
+    def emit(self, event: str, trace: Optional[str] = None,
+             **fields: Any) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "ts_ms": int(time.time() * 1000),
+            "node": self.node,
+            "event": event,
+        }
+        if trace is not None:
+            rec["trace"] = trace
+        rec.update(fields)
+        with self._lock:
+            self._ring.append(rec)
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+                self._fh.flush()
+        return rec
+
+    def tail(self, n: int = 50) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def find(self, trace: Optional[str] = None,
+             event: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            recs = list(self._ring)
+        return [
+            r for r in recs
+            if (trace is None or r.get("trace") == trace)
+            and (event is None or r.get("event") == event)
+        ]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __del__(self):  # best-effort: daemons SIGKILLed mid-run never get here
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse an event-log file back into records (forensics/tests);
+    tolerates a torn final line (the SIGKILL case)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    break  # torn tail: everything before it is intact
+    except OSError:
+        pass
+    return out

@@ -1,0 +1,108 @@
+"""Lattice-aware replication-health gauges.
+
+"Linearizable State Machine Replication of State-Based CRDTs without
+Logs" (PAPERS.md) frames the version-vector frontier as THE progress
+signal of a state-based fleet; these samplers turn each node's lattice
+state into scrape-fresh gauges:
+
+* ``vv_ops_known``          — sum over writers of (seq+1): total ops this
+                              node has absorbed (folded or raw);
+* ``frontier_folded_ops``   — how much of that the compaction frontier
+                              already folded (op-log debt = known - folded);
+* ``oplog_rows`` / ``oplog_capacity`` / ``commands_retained`` /
+  ``summary_keys``          — population of every retained structure;
+* ``set_tombstones`` / ``seq_tombstones`` / ``map_records``
+                            — GC debt of the sibling lattices;
+* ``seconds_since_last_merge`` — staleness ("Approaches to Conflict-free
+  Replicated Data Types": staleness/divergence is the metric that
+  distinguishes eventually-consistent deployments);
+* ``peer_ops_behind{peer=}`` / ``convergence_lag_ops`` — set per pull
+  round (crdt_tpu.api.node.pull_round): the delta-payload size IS how
+  many ops this node was behind that peer, and its EWMA estimates the
+  standing convergence lag under the current write/gossip ratio.
+
+Sampling happens at collection time (``render_node_metrics``), not on a
+timer: gauges are always scrape-fresh and an idle node costs nothing.
+"""
+from __future__ import annotations
+
+import time
+
+# EWMA weight of the newest pull-round lag observation (~last 5 rounds)
+LAG_ALPHA = 0.2
+
+
+def observe_pull_lag(registry, node_label: str, peer: str,
+                     ops_behind: int) -> None:
+    """Record one pull round's lag observation (called from pull_round)."""
+    registry.set_gauge("peer_ops_behind", ops_behind,
+                       node=node_label, peer=peer)
+    prev = registry.gauge_value("convergence_lag_ops", node=node_label)
+    ewma = (ops_behind if prev is None
+            else (1 - LAG_ALPHA) * prev + LAG_ALPHA * ops_behind)
+    registry.set_gauge("convergence_lag_ops", round(ewma, 3),
+                       node=node_label)
+
+
+def mark_merge(registry, node_label: str) -> None:
+    """Stamp a fresh merge (called from pull_round on fresh > 0)."""
+    registry.set_gauge("last_merge_unixtime", time.time(), node=node_label)
+
+
+def sample_kv_node(registry, node) -> None:
+    """KV replica population + frontier gauges (ReplicaNode)."""
+    lab = str(node.rid)
+    vv, frontier = node.vv_snapshot()
+    registry.set_gauge("vv_ops_known", sum(s + 1 for s in vv.values()),
+                       node=lab)
+    registry.set_gauge("frontier_folded_ops",
+                       sum(s + 1 for s in frontier.values()), node=lab)
+    registry.set_gauge("oplog_capacity", node.log.capacity, node=lab)
+    registry.set_gauge("commands_retained", len(node._commands), node=lab)
+    registry.set_gauge("summary_keys", len(node._summary), node=lab)
+    registry.set_gauge("node_alive", int(node.alive), node=lab)
+    last = registry.gauge_value("last_merge_unixtime", node=lab)
+    if last is not None:
+        registry.set_gauge("seconds_since_last_merge",
+                           round(time.time() - last, 3), node=lab)
+
+
+def sample_set_node(registry, sn) -> None:
+    lab = str(sn.rid)
+    registry.set_gauge("set_ops_retained", len(sn._ops), node=lab)
+    registry.set_gauge("set_tombstones", len(sn._tombstoned), node=lab)
+    registry.set_gauge("set_floor_folded_ops",
+                       sum(s + 1 for s in sn._floor.values()), node=lab)
+
+
+def sample_seq_node(registry, qn) -> None:
+    lab = str(qn.rid)
+    registry.set_gauge("seq_ops_retained", len(qn._ops), node=lab)
+    registry.set_gauge("seq_tombstones", len(qn._tombstoned), node=lab)
+    registry.set_gauge("seq_floor_folded_ops",
+                       sum(s + 1 for s in qn._floor.values()), node=lab)
+
+
+def sample_map_node(registry, mn) -> None:
+    registry.set_gauge("map_records", mn.n_records(), node=str(mn.rid))
+
+
+def sample_all(registry, node, set_node=None, seq_node=None,
+               map_node=None) -> None:
+    sample_kv_node(registry, node)
+    if set_node is not None:
+        sample_set_node(registry, set_node)
+    if seq_node is not None:
+        sample_seq_node(registry, seq_node)
+    if map_node is not None:
+        sample_map_node(registry, map_node)
+
+
+def render_node_metrics(node, set_node=None, seq_node=None,
+                        map_node=None) -> str:
+    """The GET /metrics body: sample health gauges into the node's
+    registry, then render the whole registry as Prometheus text."""
+    registry = node.metrics.registry
+    sample_all(registry, node, set_node=set_node, seq_node=seq_node,
+               map_node=map_node)
+    return registry.render_prometheus()

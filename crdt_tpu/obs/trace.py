@@ -1,0 +1,66 @@
+"""Cross-node gossip tracing: trace IDs minted per gossip round, carried
+over the wire in the ``X-CRDT-Trace`` header, and correlated with device
+profiles via ``jax.profiler.TraceAnnotation`` regions of the same name.
+
+A trace ID names ONE anti-entropy round end-to-end: the puller mints it
+(``mint_trace_id``), sends it with the /gossip request, and both sides
+record it in their event logs (crdt_tpu.obs.events) — so a two-node pull
+produces event lines on both nodes sharing one ID, greppable across the
+fleet's JSONL files.  ``span`` additionally opens a profiler annotation,
+so when a device trace is being captured (utils.tracing.trace_to) the
+host-side round and its device-side join dispatches line up by name in
+TensorBoard/xprof.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import os
+import threading
+
+TRACE_HEADER = "X-CRDT-Trace"
+
+# process-unique prefix + atomic counter: IDs are unique across the fleet
+# without coordination (the PID+random token disambiguates processes, the
+# counter disambiguates rounds within one)
+_PROC = f"{os.getpid():x}{os.urandom(3).hex()}"
+_SEQ = itertools.count(1)
+_SEQ_LOCK = threading.Lock()
+
+_CURRENT: contextvars.ContextVar = contextvars.ContextVar(
+    "crdt_trace", default=None
+)
+
+
+def mint_trace_id(rid: int = -1) -> str:
+    """A fleet-unique trace ID for one gossip round."""
+    with _SEQ_LOCK:
+        n = next(_SEQ)
+    return f"{rid:x}-{_PROC}-{n:x}" if rid >= 0 else f"{_PROC}-{n:x}"
+
+
+def current_trace():
+    """The trace ID of the enclosing ``span`` (None outside one)."""
+    return _CURRENT.get()
+
+
+@contextlib.contextmanager
+def span(name: str, trace_id=None):
+    """Bind ``trace_id`` (or the enclosing one) as current and open a
+    same-named profiler annotation, so the host span and its device
+    dispatches correlate by name in a captured trace.  Yields the trace
+    ID.  jax is imported lazily: event-log-only consumers (the crash-soak
+    report reader) never pay the import."""
+    tid = trace_id or current_trace() or mint_trace_id()
+    token = _CURRENT.set(tid)
+    try:
+        try:
+            import jax
+            annotation = jax.profiler.TraceAnnotation(name)
+        except ImportError:  # pragma: no cover - jax is a hard dep in-tree
+            annotation = contextlib.nullcontext()
+        with annotation:
+            yield tid
+    finally:
+        _CURRENT.reset(token)

@@ -54,14 +54,17 @@ def tree_reduce_join(join_fn: Callable, state: Any, neutral: Any) -> Any:
     to pad R up to a power of two (every model module exports a suitable
     ``zero``/``empty``).
     """
-    state = pad_to_pow2(state, neutral)
-    p = _leading_dim(state)
-    while p > 1:
-        p //= 2
-        lo = jax.tree.map(lambda x: x[:p], state)
-        hi = jax.tree.map(lambda x: x[p : 2 * p], state)
-        state = join_fn(lo, hi)
-    return jax.tree.map(lambda x: x[0], state)
+    # profiler region: tree-reduce dispatches correlate by name with the
+    # host-side gossip/merge spans in a captured trace (crdt_tpu.obs.trace)
+    with jax.profiler.TraceAnnotation("crdt.tree_reduce_join"):
+        state = pad_to_pow2(state, neutral)
+        p = _leading_dim(state)
+        while p > 1:
+            p //= 2
+            lo = jax.tree.map(lambda x: x[:p], state)
+            hi = jax.tree.map(lambda x: x[p : 2 * p], state)
+            state = join_fn(lo, hi)
+        return jax.tree.map(lambda x: x[0], state)
 
 
 def converge(join_fn: Callable, state: Any, neutral: Any) -> Any:

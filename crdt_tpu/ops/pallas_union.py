@@ -782,17 +782,20 @@ def sorted_union_columnar_lexn_auto(
     (tests/test_pallas_union.py)."""
     c = keys_a[0].shape[0]
     n_planes = len(keys_a) + len(vals_a)
-    # +1: the fused kernel's nu/compaction bookkeeping holds an extra
-    # plane's worth of live temporaries vs the merge-only kernel
-    if interpret or lexn_fits(c, n_planes + 1):
-        return sorted_union_columnar_fused_lexn(
+    # profiler region: device-side union dispatches line up by name with
+    # the host-side gossip span in a captured trace (crdt_tpu.obs.trace)
+    with jax.profiler.TraceAnnotation("crdt.union_lexn"):
+        # +1: the fused kernel's nu/compaction bookkeeping holds an extra
+        # plane's worth of live temporaries vs the merge-only kernel
+        if interpret or lexn_fits(c, n_planes + 1):
+            return sorted_union_columnar_fused_lexn(
+                keys_a, vals_a, keys_b, vals_b,
+                out_size=out_size, interpret=interpret,
+            )
+        return sorted_union_columnar_striped_lexn(
             keys_a, vals_a, keys_b, vals_b,
             out_size=out_size, interpret=interpret,
         )
-    return sorted_union_columnar_striped_lexn(
-        keys_a, vals_a, keys_b, vals_b,
-        out_size=out_size, interpret=interpret,
-    )
 
 
 def sorted_union_columnar_fused_lex2(
@@ -868,6 +871,8 @@ def sorted_union_columnar(
     Dispatches to the fully-fused kernel (_union_kernel: merge + dedupe +
     compaction in one VMEM round trip); sorted_union_columnar_unfused keeps
     the two-pass variant for comparison."""
-    return sorted_union_columnar_fused(
-        keys_a, vals_a, keys_b, vals_b, out_size=out_size, interpret=interpret
-    )
+    with jax.profiler.TraceAnnotation("crdt.union"):
+        return sorted_union_columnar_fused(
+            keys_a, vals_a, keys_b, vals_b, out_size=out_size,
+            interpret=interpret,
+        )

@@ -1,38 +1,74 @@
-"""Observability: merge/gossip counters and latency percentiles.
+"""Observability shim: the legacy ``Metrics`` surface over the real
+registry (crdt_tpu.obs.registry).
 
-The reference's only observability is gin's request log (SURVEY.md §5);
-BASELINE.md asks for merges/sec and p50 merge latency, so those are
-first-class here.  `jax.profiler` tracing hooks live in utils.tracing.
+Historically this module WAS the observability layer — a counter dict plus
+deque latency reservoirs.  It is now a compatibility facade so the many
+existing callers (api/node.py, api/cluster.py, the soak harnesses, tests)
+keep working while all storage lives in one ``MetricsRegistry`` that the
+HTTP shim exposes as Prometheus text (GET /metrics).
+
+Two old bugs are fixed here rather than preserved:
+
+* ``observe()`` no longer double-counts into the ``inc()`` counter space —
+  a name used for both a counter and a timer no longer conflates "events
+  counted" with "durations recorded" (histogram counts are reported as
+  ``{name}_count``);
+* ``snapshot()`` is one atomic registry copy (the old version iterated
+  ``self._lat`` outside the lock while writer threads appended);
+* ``rate()`` grows a windowed mode: ``rate(name, window=5.0)`` measures
+  over (up to) the trailing window instead of since construction.
 """
 from __future__ import annotations
 
 import collections
-import statistics
 import threading
 import time
-from typing import Dict
+from typing import Deque, Dict, Optional, Tuple
+
+from crdt_tpu.obs.registry import MetricsRegistry
+
+# minimum spacing of the rate-sample marks (bounds per-counter memory and
+# the perf_counter cost on hot inc paths)
+_SAMPLE_EVERY_S = 0.05
+_SAMPLES_MAX = 128
 
 
 class Metrics:
-    """Thread-safe counters + latency reservoirs (host-side; device work is
-    measured around block_until_ready boundaries by callers)."""
+    """Thread-safe counters + latency histograms over a shared registry.
 
-    def __init__(self, reservoir: int = 4096):
-        self._lock = threading.Lock()
-        self._counts: Dict[str, int] = collections.defaultdict(int)
-        self._lat: Dict[str, collections.deque] = collections.defaultdict(
-            lambda: collections.deque(maxlen=reservoir)
-        )
+    ``registry`` may be shared between several Metrics instances (a
+    LocalCluster's nodes) or swapped for ``obs.NULL_REGISTRY`` to measure
+    instrumentation overhead.  Label-free fast paths only — labeled
+    series are recorded straight on ``self.registry``.
+    """
+
+    def __init__(self, reservoir: int = 4096,
+                 registry: Optional[MetricsRegistry] = None):
+        # `reservoir` is accepted for back-compat; histograms are fixed-size
+        self.registry = registry if registry is not None else MetricsRegistry()
         self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        # windowed-rate marks: name -> deque[(t, cumulative count)]
+        self._samples: Dict[str, Deque[Tuple[float, float]]] = {}
+
+    # ---- recording ----
 
     def inc(self, name: str, n: int = 1) -> None:
+        self.registry.inc(name, n)
+        if not self.registry.enabled:  # null registry: skip rate marks too
+            return
+        now = time.perf_counter()
         with self._lock:
-            self._counts[name] += n
+            dq = self._samples.get(name)
+            if dq is None:
+                dq = self._samples[name] = collections.deque(
+                    maxlen=_SAMPLES_MAX
+                )
+            if not dq or now - dq[-1][0] >= _SAMPLE_EVERY_S:
+                dq.append((now, self.registry.counter_value(name)))
 
     def observe(self, name: str, seconds: float) -> None:
-        with self._lock:
-            self._lat[name].append(seconds)
-            self._counts[name] += 1
+        self.registry.observe(name, seconds)
 
     class _Timer:
         def __init__(self, m: "Metrics", name: str):
@@ -48,25 +84,50 @@ class Metrics:
     def timer(self, name: str) -> "_Timer":
         return self._Timer(self, name)
 
-    def rate(self, name: str) -> float:
+    # ---- reading ----
+
+    @property
+    def _counts(self) -> Dict[str, int]:
+        """Back-compat view of the label-free counters (tests poke it)."""
+        out: Dict[str, int] = {}
+        with self.registry._lock:
+            for (name, labels), v in self.registry._counters.items():
+                if not labels:
+                    out[name] = int(v)
+        return out
+
+    def rate(self, name: str, window: Optional[float] = None) -> float:
+        """Events/sec: lifetime when ``window`` is None, else over (up to)
+        the trailing ``window`` seconds of recorded activity."""
+        now = time.perf_counter()
+        cur = self.registry.counter_value(name)
+        if window is None:
+            return cur / max(now - self._t0, 1e-9)
         with self._lock:
-            return self._counts[name] / max(time.perf_counter() - self._t0, 1e-9)
+            dq = self._samples.get(name)
+            marks = list(dq) if dq else []
+        cutoff = now - window
+        # oldest mark inside the window; fall back to the newest mark
+        # before it (the count was already there when the window opened)
+        base_t, base_v = max(self._t0, cutoff), 0.0
+        older = [m for m in marks if m[0] <= cutoff]
+        inside = [m for m in marks if m[0] > cutoff]
+        if older:
+            base_v = older[-1][1]
+        elif inside:
+            base_t, base_v = inside[0]
+        elif self._t0 <= cutoff:
+            base_v = cur  # no activity recorded in the window at all
+        return max(cur - base_v, 0.0) / max(now - base_t, 1e-9)
 
     def p50(self, name: str) -> float:
-        with self._lock:
-            lat = list(self._lat[name])
-        return statistics.median(lat) if lat else float("nan")
+        return self.quantile(name, 0.5)
 
     def quantile(self, name: str, q: float) -> float:
-        with self._lock:
-            lat = sorted(self._lat[name])
-        if not lat:
-            return float("nan")
-        return lat[min(int(q * len(lat)), len(lat) - 1)]
+        h = self.registry.histogram(name)
+        return h.quantile(q) if h is not None else float("nan")
 
     def snapshot(self) -> dict:
-        with self._lock:
-            out = dict(self._counts)
-        for name in list(self._lat):
-            out[f"{name}_p50_ms"] = round(self.p50(name) * 1e3, 3)
-        return out
+        """Counters by name + ``{name}_count``/``{name}_p50_ms`` per
+        histogram, copied atomically (one registry lock acquisition)."""
+        return self.registry.snapshot()

@@ -1,0 +1,386 @@
+"""Unified telemetry layer tests (crdt_tpu.obs): the Prometheus
+exposition, the mergeable histogram's lattice laws, cross-node trace
+propagation, the JSONL event log, and the Metrics shim regressions
+(observe() double-count, windowed rate, atomic snapshot).
+
+The histogram merge tests mirror tests/test_lattice_laws.py: a mergeable
+histogram is itself a (grow-only) join-semilattice element under
+elementwise add, so fleet-wide folds must be order-insensitive.
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.request
+
+import pytest
+
+from crdt_tpu.obs.events import EventLog, read_jsonl
+from crdt_tpu.obs.registry import (
+    N_BUCKETS,
+    NULL_REGISTRY,
+    Histogram,
+    MetricsRegistry,
+    bucket_index,
+)
+from crdt_tpu.obs.trace import TRACE_HEADER, current_trace, mint_trace_id, span
+from crdt_tpu.utils.metrics import Metrics
+
+
+def _rand_hist(rng: random.Random, n: int) -> Histogram:
+    h = Histogram()
+    for _ in range(n):
+        # spread over ~the full bucket range, us .. minutes
+        h.observe(rng.uniform(0, 1) * 10 ** rng.randint(-6, 2))
+    return h
+
+
+# ---------------------------------------------------------------- histogram
+
+
+def test_histogram_merge_associative_commutative():
+    """Property-style over random fills: merge is associative and
+    commutative (so per-node histograms fold fleet-wide in any order),
+    and the empty histogram is the identity."""
+    rng = random.Random(0xC4D7)
+    for trial in range(50):
+        a = _rand_hist(rng, rng.randint(0, 40))
+        b = _rand_hist(rng, rng.randint(0, 40))
+        c = _rand_hist(rng, rng.randint(0, 40))
+        assert a.merge(b) == b.merge(a), trial
+        assert a.merge(b).merge(c) == a.merge(b.merge(c)), trial
+        assert a.merge(Histogram()) == a, trial
+        merged = a.merge(b)
+        assert merged.count == a.count + b.count
+        assert sum(merged.buckets) == merged.count
+
+
+def test_histogram_merge_does_not_alias():
+    a, b = Histogram(), Histogram()
+    a.observe(0.01)
+    out = a.merge(b)
+    out.observe(0.01)
+    assert a.count == 1 and b.count == 0  # merge returned a fresh histogram
+
+
+def test_bucket_index_monotone_and_bounded():
+    prev = 0
+    for v in (1e-9, 1e-6, 1e-3, 0.1, 1.0, 60.0, 1e3, 1e9):
+        i = bucket_index(v)
+        assert 0 <= i < N_BUCKETS
+        assert i >= prev
+        prev = i
+    assert bucket_index(1e-12) == 0
+    assert bucket_index(1e12) == N_BUCKETS - 1
+
+
+def test_histogram_quantile():
+    h = Histogram()
+    assert h.quantile(0.5) != h.quantile(0.5)  # NaN when empty
+    for _ in range(100):
+        h.observe(0.010)  # ~10ms
+    q = h.quantile(0.5)
+    # log2 buckets: estimate is the bucket upper bound, within one octave
+    assert 0.010 <= q <= 0.020
+
+
+# ----------------------------------------------------------------- registry
+
+
+def test_registry_counters_gauges_labels():
+    r = MetricsRegistry()
+    r.inc("pulls")
+    r.inc("pulls", 2)
+    r.inc("pulls", peer="n1")
+    r.set_gauge("lag", 7.5, node="0")
+    assert r.counter_value("pulls") == 3
+    assert r.counter_value("pulls", peer="n1") == 1
+    assert r.counter_value("absent") == 0
+    assert r.gauge_value("lag", node="0") == 7.5
+    assert r.gauge_value("lag") is None
+
+
+def test_registry_snapshot_shape():
+    r = MetricsRegistry()
+    r.inc("gossip_rounds")
+    r.observe("merge", 0.004)
+    r.set_gauge("alive", 1, node="2")
+    snap = r.snapshot()
+    assert snap["gossip_rounds"] == 1
+    assert snap["merge_count"] == 1
+    assert snap["merge_p50_ms"] > 0
+    assert snap['alive{node="2"}'] == 1
+
+
+def test_snapshot_atomic_under_concurrent_writers():
+    """snapshot() must be one consistent copy while writers hammer the
+    registry (the old Metrics.snapshot iterated reservoirs outside the
+    lock).  Counters observed across snapshots must be nondecreasing and
+    no snapshot may raise."""
+    m = Metrics()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            m.inc("w")
+            m.observe("lat", 0.001)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        prev_w, prev_n = -1.0, -1
+        for _ in range(200):
+            snap = m.snapshot()
+            w, n = snap.get("w", 0.0), snap.get("lat_count", 0)
+            assert w >= prev_w and n >= prev_n
+            prev_w, prev_n = w, n
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+def test_null_registry_is_inert():
+    NULL_REGISTRY.inc("x")
+    NULL_REGISTRY.observe("x", 1.0)
+    NULL_REGISTRY.set_gauge("x", 1.0)
+    assert NULL_REGISTRY.counter_value("x") == 0
+    assert NULL_REGISTRY.histogram("x") is None
+    m = Metrics(registry=NULL_REGISTRY)
+    m.inc("y")
+    with m.timer("t"):
+        pass
+    assert m.snapshot() == {}
+
+
+# -------------------------------------------------------- Prometheus text
+
+# one exposition line: name{labels}? value
+import re  # noqa: E402
+
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z0-9_]+="[^"]*"(,[a-zA-Z0-9_]+="[^"]*")*\})?'
+    r" [0-9eE+.inf-]+$"
+)
+
+
+def _check_prometheus(text: str) -> int:
+    """Validate 0.0.4 text exposition; returns the number of sample lines."""
+    n = 0
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            kind = line.split()[-1]
+            assert kind in ("counter", "gauge", "histogram"), line
+            continue
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+        n += 1
+    return n
+
+
+def test_render_prometheus_format():
+    r = MetricsRegistry()
+    r.inc("gossip_rounds", peer="http://127.0.0.1:1")
+    r.set_gauge("convergence_lag_ops", 2.4, node="0")
+    for v in (1e-5, 0.002, 0.004, 0.3):
+        r.observe("merge", v)
+    text = r.render_prometheus()
+    assert _check_prometheus(text) >= 3
+    assert "# TYPE crdt_gossip_rounds_total counter" in text
+    assert "# TYPE crdt_convergence_lag_ops gauge" in text
+    assert "# TYPE crdt_merge_seconds histogram" in text
+    # histogram invariants: cumulative buckets end at count; sum present
+    buckets = [
+        int(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("crdt_merge_seconds_bucket")
+    ]
+    assert buckets == sorted(buckets)  # cumulative -> nondecreasing
+    assert buckets[-1] == 4
+    assert 'le="+Inf"' in text
+    assert "crdt_merge_seconds_sum" in text
+    assert "crdt_merge_seconds_count 4" in text
+
+
+def test_scrape_callbacks_run_at_collection():
+    r = MetricsRegistry()
+    r.add_callback(lambda reg: reg.set_gauge("sampled", 42))
+    assert "crdt_sampled 42" in r.render_prometheus()
+    assert r.snapshot()["sampled"] == 42
+
+
+# ------------------------------------------------------------ Metrics shim
+
+
+def test_observe_does_not_double_count():
+    """Regression: the old Metrics.observe() also bumped the counter of
+    the same name, so 'merge' reported events + durations conflated."""
+    m = Metrics()
+    m.inc("merge_events", 3)
+    for _ in range(5):
+        m.observe("merge", 0.002)
+    snap = m.snapshot()
+    assert snap["merge_count"] == 5
+    assert snap["merge_events"] == 3
+    assert "merge" not in snap  # no phantom counter from observe()
+    assert "merge" not in m._counts
+    assert m.registry.counter_value("merge") == 0
+
+
+def test_counts_backcompat_view():
+    m = Metrics()
+    m.inc("seq_collect_behind")
+    m.registry.inc("labeled", peer="x")  # labeled series not in _counts
+    assert m._counts == {"seq_collect_behind": 1}
+
+
+def test_timer_and_quantiles():
+    m = Metrics()
+    with m.timer("merge"):
+        pass
+    assert m.snapshot()["merge_count"] == 1
+    assert m.p50("merge") > 0
+    assert m.quantile("absent", 0.5) != m.quantile("absent", 0.5)  # NaN
+
+
+def test_rate_lifetime_and_windowed():
+    m = Metrics()
+    for _ in range(10):
+        m.inc("ops")
+    assert m.rate("ops") > 0
+    assert m.rate("absent") == 0
+    # a window covering the whole lifetime sees every event
+    full = m.rate("ops", window=60.0)
+    assert full == pytest.approx(m.rate("ops"), rel=0.5)
+    assert m.rate("absent", window=60.0) == 0
+
+
+# ------------------------------------------------------------- event logs
+
+
+def test_event_log_ring_and_file(tmp_path):
+    p = str(tmp_path / "events.jsonl")
+    log = EventLog(node="7", path=p, capacity=4)
+    for i in range(6):
+        log.emit("tick", i=i)
+    log.emit("pull_merge", trace="abc", fresh=2)
+    assert len(log) == 4  # ring bounded
+    assert log.tail(1)[0]["event"] == "pull_merge"
+    assert log.find(trace="abc")[0]["fresh"] == 2
+    assert log.find(event="tick", trace="abc") == []
+    log.close()
+    recs = read_jsonl(p)
+    assert len(recs) == 7  # the file keeps everything
+    assert recs[-1]["node"] == "7" and recs[-1]["trace"] == "abc"
+    assert all("ts_ms" in r for r in recs)
+
+
+def test_read_jsonl_tolerates_torn_tail(tmp_path):
+    """A SIGKILL can tear the final line mid-write; everything before the
+    tear must still parse (the crash soak's black-box reader)."""
+    p = tmp_path / "events.jsonl"
+    with open(p, "w") as fh:
+        fh.write(json.dumps({"event": "boot"}) + "\n")
+        fh.write(json.dumps({"event": "pull_merge"}) + "\n")
+        fh.write('{"event": "pull_m')  # torn
+    recs = read_jsonl(str(p))
+    assert [r["event"] for r in recs] == ["boot", "pull_merge"]
+    assert read_jsonl(str(tmp_path / "absent.jsonl")) == []
+
+
+# ---------------------------------------------------------------- tracing
+
+
+def test_mint_trace_id_unique_and_rid_tagged():
+    ids = {mint_trace_id(3) for _ in range(100)}
+    assert len(ids) == 100
+    assert all(i.startswith("3-") for i in ids)
+
+
+def test_span_binds_current_trace():
+    assert current_trace() is None
+    with span("crdt.test", "tid-1") as tid:
+        assert tid == "tid-1" and current_trace() == "tid-1"
+        with span("crdt.inner") as inner:  # inherits the enclosing trace
+            assert inner == "tid-1"
+    assert current_trace() is None
+
+
+# ------------------------------------------- end-to-end over real sockets
+
+
+@pytest.fixture
+def traced_pair(tmp_path):
+    """Two standalone NodeHosts with JSONL event logs, peered (mirrors
+    tests/test_net.py's pair, plus the black-box files)."""
+    from crdt_tpu.api.net import NodeHost, RemotePeer
+
+    a = NodeHost(rid=0, peers=[], event_log=str(tmp_path / "a.jsonl"))
+    b = NodeHost(rid=1, peers=[], event_log=str(tmp_path / "b.jsonl"))
+    a.agent.peers = [RemotePeer(b.url)]
+    b.agent.peers = [RemotePeer(a.url)]
+    for h in (a, b):
+        t = threading.Thread(target=h._server.serve_forever, daemon=True)
+        t.start()
+    yield a, b
+    for h in (a, b):
+        h._server.shutdown()
+        h._server.server_close()
+
+
+def test_trace_survives_two_node_pull(traced_pair, tmp_path):
+    """One gossip round = one trace ID on BOTH ends of the wire: the
+    puller's pull_merge event and the server's gossip_serve event carry
+    the same ID, in memory and in both JSONL files."""
+    from crdt_tpu.api.net import RemotePeer
+
+    a, b = traced_pair
+    RemotePeer(a.url).add_command({"x": "5"})
+    assert b.agent.gossip_once()  # b pulls from a
+
+    merges = b.node.events.find(event="pull_merge")
+    assert merges, [e["event"] for e in b.node.events.tail()]
+    tid = merges[-1]["trace"]
+    assert tid.startswith("1-")  # minted by the puller (rid=1)
+    serves = a.node.events.find(event="gossip_serve", trace=tid)
+    assert serves and serves[-1]["delta"] is True
+
+    # and the same ID is greppable across both black-box files
+    for fname, event in (("a.jsonl", "gossip_serve"), ("b.jsonl", "pull_merge")):
+        recs = read_jsonl(str(tmp_path / fname))
+        assert any(
+            r.get("trace") == tid and r["event"] == event for r in recs
+        ), (fname, tid)
+    # boot events were flushed at construction time on both hosts
+    assert read_jsonl(str(tmp_path / "a.jsonl"))[0]["event"] == "boot"
+
+
+def test_metrics_endpoint_prometheus(traced_pair):
+    """GET /metrics is valid Prometheus text with ≥10 series including
+    the gossip counters and the scrape-time lattice health gauges."""
+    from crdt_tpu.api.net import RemotePeer
+
+    a, b = traced_pair
+    RemotePeer(a.url).add_command({"x": "1"})
+    RemotePeer(a.url).add_command({"y": "2"})
+    assert b.agent.gossip_once()
+
+    with urllib.request.urlopen(b.url + "/metrics", timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert "version=0.0.4" in resp.headers["Content-Type"]
+        text = resp.read().decode()
+
+    n_series = _check_prometheus(text)
+    assert n_series >= 10, text
+    assert "crdt_net_gossip_rounds_total" in text
+    assert "crdt_net_gossip_payload_ops_total" in text
+    assert "crdt_ops_ingested_total" in text
+    # lattice health gauges sampled at scrape time
+    assert 'crdt_node_alive{node="1"} 1' in text
+    assert 'crdt_vv_ops_known{node="1"}' in text
+    assert 'crdt_peer_ops_behind{node="1",peer=' in text
+    assert 'crdt_convergence_lag_ops{node="1"}' in text
+    assert "crdt_merge_seconds_bucket" in text
