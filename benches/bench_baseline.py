@@ -103,7 +103,7 @@ OBS = MetricsRegistry()
 
 
 def _emit(results, name, value, unit, note, bytes_per_step=None,
-          sec_per_step=None, traffic_kind="hbm"):
+          sec_per_step=None, traffic_kind="hbm", dispatches=None):
     """One JSON line per config.  When the caller supplies its per-step
     traffic model (bytes_per_step) and the measured step time, the line
     carries bytes-moved + effective TB/s + %-of-819-GB/s-spec columns, so
@@ -111,7 +111,11 @@ def _emit(results, name, value, unit, note, bytes_per_step=None,
     (round-4 verdict weak #2: the PN 1M regression stayed latent for four
     rounds because only merges/s was recorded).  traffic_kind="compute"
     marks kernel-family rows whose bound is the VPU, not HBM (their TB/s
-    is expected to sit far below spec -- see PERF.md roofline)."""
+    is expected to sit far below spec -- see PERF.md roofline).
+    ``dispatches`` records the config's device-dispatch count per logical
+    work unit (PERF.md "Dispatch-bound layer"): each dispatch rides the
+    ~75 ms tunnel RTT, so the column makes dispatch-bound rows auditable
+    from the JSON alone."""
     line = {"metric": name, "value": round(value, 1), "unit": unit,
             "vs_baseline": None, "note": note}
     if bytes_per_step is not None and sec_per_step:
@@ -120,6 +124,8 @@ def _emit(results, name, value, unit, note, bytes_per_step=None,
         line["eff_tb_s"] = round(eff, 3)
         line["pct_hbm_spec"] = round(100 * eff / HBM_SPEC_TB_S, 1)
         line["traffic_kind"] = traffic_kind
+    if dispatches is not None:
+        line["device_dispatches"] = int(dispatches)
     print(json.dumps(line), flush=True)
     results.append(line)
     OBS.inc("bench_rows")
@@ -384,16 +390,22 @@ def _orset_union_rate(seed, c, ln, tiny, bank_n=None, chained_fn_cache={}):
 
     # HBM budget (v5e: 16 GB): inputs 2·C·ln·4 B (a) + bank_n·2·C·ln·4 B,
     # outputs 2·C·ln·4 B transient (out_size=C in-kernel truncation), PLUS
-    # the fori_loop carry (2 planes, double-buffered — not donatable: the
-    # timed calls reuse the operands).  At 256K lanes a C=1024 plane is
-    # 1 GB and a two-peer bank would push the working set past ~12 GB (it
-    # OOM'd with residue from earlier sweep points), so shrink the bank to
-    # ONE peer there — the loop body stays collapse-proof because
-    # pallas_call is an opaque custom call XLA cannot algebraically
-    # simplify (unlike jnp.maximum).
+    # the fori_loop carry (2 planes).  On donating backends the (ka, va)
+    # carry SEEDS are donated too (crdt_tpu.ops.joins donation rule): the
+    # timed call then owns its carry outright and XLA writes the loop in
+    # place — each rep passes a fresh jnp.copy of the seeds, whose cost is
+    # identical at both K values and cancels in the difference quotient.
+    # At 256K lanes a C=1024 plane is 1 GB and a two-peer bank would push
+    # the working set past ~12 GB (it OOM'd with residue from earlier
+    # sweep points), so shrink the bank to ONE peer there — the loop body
+    # stays collapse-proof because pallas_call is an opaque custom call
+    # XLA cannot algebraically simplify (unlike jnp.maximum).
     if bank_n is None:
         bank_n = 1 if c * ln * 4 >= (1 << 30) else 2
     interpret = jax.default_backend() != "tpu"
+    from crdt_tpu.ops.joins import _DONATING_BACKENDS
+
+    donate = (0, 1) if jax.default_backend() in _DONATING_BACKENDS else ()
 
     def cols(key, fill):
         ks = jax.random.randint(key, (c, ln), 0, 1 << 30, dtype=jnp.int32)
@@ -407,9 +419,9 @@ def _orset_union_rate(seed, c, ln, tiny, bank_n=None, chained_fn_cache={}):
     bank_k = jnp.stack([b[0] for b in bank])
     bank_v = jnp.stack([b[1] for b in bank])
 
-    cache_key = (c, ln, bank_n, interpret)
+    cache_key = (c, ln, bank_n, interpret, donate)
     if cache_key not in chained_fn_cache:
-        @partial(jax.jit, static_argnames="k")
+        @partial(jax.jit, static_argnames="k", donate_argnums=donate)
         def chained(ka, va, bank_k, bank_v, k):
             def body(i, carry):
                 kx, vx = carry
@@ -434,8 +446,16 @@ def _orset_union_rate(seed, c, ln, tiny, bank_n=None, chained_fn_cache={}):
         jax.block_until_ready(out)
         return None
     ks_, kl = (2, 6) if tiny else (8, 32)
-    per = _timed(lambda k: int(chained(ka, va, bank_k, bank_v, k)), ks_, kl,
-                 min_diff=0 if tiny else MIN_DIFF_S)
+    if donate:
+        # donated seeds are DELETED at dispatch: hand each timed call its
+        # own copy (cost cancels across the two K values)
+        def run(k):
+            return int(chained(jnp.copy(ka), jnp.copy(va),
+                               bank_k, bank_v, k))
+    else:
+        def run(k):
+            return int(chained(ka, va, bank_k, bank_v, k))
+    per = _timed(run, ks_, kl, min_diff=0 if tiny else MIN_DIFF_S)
     # free this shape's operands before the caller builds the next stripe/
     # sweep point; gc.collect() breaks any lingering cycles so the device
     # buffers actually release (the 256K point needs the headroom)
@@ -516,9 +536,9 @@ def bench_orset_1m(results, tiny):
           f"MEASURED at BASELINE shape: C={c} x {n_lanes} lanes as "
           f"{stripes} x {stripe_lanes}-lane stripes; one full union = "
           f"{total * 1e3:.0f} ms (per-stripe {min(pers) * 1e3:.1f}-"
-          f"{max(pers) * 1e3:.1f} ms)",
+          f"{max(pers) * 1e3:.1f} ms); carry seeds donated on-chip",
           bytes_per_step=6 * c * n_lanes * 4, sec_per_step=total,
-          traffic_kind="compute")
+          traffic_kind="compute", dispatches=stripes)
 
 
 def bench_gossip_allreduce(results, tiny):
@@ -577,6 +597,17 @@ def bench_rseq_striped(results, tiny):
             results.append(line)
 
 
+def bench_stripe_pipeline(results, tiny):
+    """Serial vs double-buffered stripe execution A/B (the pipelined merge
+    runtime's host-overlap arm; standalone driver with the staging cost
+    models: benches/bench_pipeline.py)."""
+    from benches import bench_pipeline as bp
+
+    for line in bp.run_ab(tiny):
+        print(json.dumps(line), flush=True)
+        results.append(line)
+
+
 ALL = {
     "gcounter_pair": bench_gcounter_pair,
     "pncounter_vmap": bench_pncounter_vmap,
@@ -587,6 +618,7 @@ ALL = {
     "orset_union": bench_orset_union,
     "orset_sweep": bench_orset_sweep,
     "orset_1m": bench_orset_1m,
+    "stripe_pipeline": bench_stripe_pipeline,
     "rseq_striped": bench_rseq_striped,
     "gossip_allreduce": bench_gossip_allreduce,
 }

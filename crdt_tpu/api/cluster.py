@@ -16,7 +16,12 @@ import random
 import threading
 from typing import Dict, List, Optional
 
-from crdt_tpu.api.node import ReplicaNode, pull_round, stable_frontier_host
+from crdt_tpu.api.node import (
+    ReplicaNode,
+    fused_pull_round,
+    pull_round,
+    stable_frontier_host,
+)
 from crdt_tpu.obs.trace import mint_trace_id
 from crdt_tpu.utils.clock import HostClock
 from crdt_tpu.utils.config import ClusterConfig
@@ -94,8 +99,14 @@ class LocalCluster:
 
     def gossip_once(self, idx: int) -> bool:
         """One pull by the idx-th replica from a random friend; returns True
-        if a merge happened (dead/missing peers are skipped, main.go:235-239)."""
+        if a merge happened (dead/missing peers are skipped, main.go:235-239).
+        With ``config.fuse_pull_k > 1`` the round instead pulls k distinct
+        friends and merges every payload in ONE device dispatch
+        (_gossip_once_fused); the default k=1 keeps this path — and every
+        seeded schedule's RNG draw sequence — exactly as before."""
         node = self.nodes[idx]
+        if min(self.config.fuse_pull_k, len(self._friend_pool(idx))) > 1:
+            return self._gossip_once_fused(idx)
         peer = self._rng.choice(self._friend_pool(idx))
         if peer is None or peer is node or not peer.alive:
             self.metrics.inc("gossip_skipped")
@@ -119,9 +130,49 @@ class LocalCluster:
             peer=str(peer.rid),
             trace=tid,
         )
+        self._sibling_pulls(idx, self.nodes.index(peer))
+        return merged
+
+    def _gossip_once_fused(self, idx: int) -> bool:
+        """One k-way fused pull round by the idx-th replica: sample k
+        DISTINCT friends, fetch each one's delta payload against the same
+        pre-round version vector, and merge every response in a single
+        device dispatch (fused_pull_round → ReplicaNode.receive_many).
+        Dead/missing friends count per-peer skips exactly like the
+        sequential path; union-ACI makes the fused merge bit-equal to k
+        sequential rounds against the same payloads (tests/test_pipeline)."""
+        node = self.nodes[idx]
+        pool = self._friend_pool(idx)
+        chosen = self._rng.sample(pool, min(self.config.fuse_pull_k,
+                                            len(pool)))
+        tid = mint_trace_id(node.rid)
+        since = node.version_vector() if self.config.delta_gossip else None
+        fetched, live = [], []
+        for peer in chosen:
+            if peer is None or peer is node or not peer.alive:
+                fetched.append(
+                    (None if peer is None else str(peer.rid), None))
+                continue
+            payload = peer.gossip_payload(since=since)
+            if payload is not None:
+                peer.events.emit("gossip_serve", trace=tid,
+                                 peer=str(node.rid), delta=since is not None)
+                live.append(peer)
+            fetched.append((str(peer.rid), payload))
+        merged = fused_pull_round(
+            node,
+            fetched,
+            self.metrics,
+            delta=self.config.delta_gossip,
+            trace=tid,
+        )
+        for peer in live:
+            self._sibling_pulls(idx, self.nodes.index(peer))
+        return merged
+
+    def _sibling_pulls(self, idx: int, peer_idx: int) -> None:
         # set-lattice pull riding the same round (KV result returned —
         # the surfaces' freshness is never conflated, api/net.py rule)
-        peer_idx = self.nodes.index(peer)
         sn, psn = self.set_nodes[idx], self.set_nodes[peer_idx]
         if sn.alive and psn.alive:
             fresh = sn.receive(
@@ -146,7 +197,6 @@ class LocalCluster:
             self.metrics.inc(
                 "map_gossip_rounds" if fresh else "map_gossip_noop"
             )
-        return merged
 
     def tick(self) -> int:
         """One gossip round for every replica; returns merges performed.

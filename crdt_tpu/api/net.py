@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import random
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -32,7 +33,12 @@ from concurrent.futures import ThreadPoolExecutor
 from http.server import ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
-from crdt_tpu.api.node import ReplicaNode, pull_round, stable_frontier_host
+from crdt_tpu.api.node import (
+    ReplicaNode,
+    fused_pull_round,
+    pull_round,
+    stable_frontier_host,
+)
 from crdt_tpu.obs.events import EventLog
 from crdt_tpu.obs.trace import TRACE_HEADER, mint_trace_id
 from crdt_tpu.utils.config import ClusterConfig
@@ -42,7 +48,8 @@ from crdt_tpu.utils.metrics import Metrics
 class RemotePeer:
     """Client for one peer's reference-surface HTTP endpoint."""
 
-    def __init__(self, url: str, timeout: float = 5.0):
+    def __init__(self, url: str, timeout: float = 5.0,
+                 backoff_base_s: float = 0.5, backoff_cap_s: float = 30.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
         # None = unknown, False = peer 404'd /set/gossip (an original
@@ -52,15 +59,47 @@ class RemotePeer:
         self.serves_set: Optional[bool] = None
         self.serves_seq: Optional[bool] = None  # same, for /seq/gossip
         self.serves_map: Optional[bool] = None  # same, for /map/gossip
+        # per-peer transport backoff: consecutive TRANSPORT failures
+        # (connection refused / socket timeout — the peer's process or
+        # network is gone) push retry_at out exponentially so one
+        # unreachable peer cannot stall every round at full timeout.  A
+        # reachable peer that answers with ANY HTTP status — including the
+        # dead-node 502 — resets the clock: it costs the round ~nothing
+        # and may revive at any moment (tests/test_net.py pins that a
+        # revived node is pulled on the very next round).
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.failures = 0
+        self.retry_at = 0.0  # time.monotonic() deadline; 0 = available
+
+    def _note_reachable(self) -> None:
+        self.failures = 0
+        self.retry_at = 0.0
+
+    def _note_transport_failure(self) -> None:
+        self.failures += 1
+        delay = min(self.backoff_cap_s,
+                    self.backoff_base_s * (2 ** (self.failures - 1)))
+        self.retry_at = time.monotonic() + delay
+
+    def backed_off(self) -> bool:
+        """True while the transport-failure backoff window is open."""
+        return time.monotonic() < self.retry_at
 
     def _get(self, path: str,
              headers: Optional[Dict[str, str]] = None) -> Optional[bytes]:
         req = urllib.request.Request(self.url + path, headers=headers or {})
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as res:
-                return res.read() if res.status == 200 else None
+                body = res.read() if res.status == 200 else None
+        except urllib.error.HTTPError:
+            self._note_reachable()  # served an error status: peer is UP
+            return None
         except (urllib.error.URLError, OSError):
-            return None  # unreachable/dead peer: caller skips (main.go:235-239)
+            self._note_transport_failure()
+            return None  # unreachable peer: caller skips (main.go:235-239)
+        self._note_reachable()
+        return body
 
     def _post(self, path: str, body: dict) -> bool:
         req = urllib.request.Request(
@@ -71,9 +110,15 @@ class RemotePeer:
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as res:
-                return res.status == 200
-        except (urllib.error.URLError, OSError):
+                ok = res.status == 200
+        except urllib.error.HTTPError:
+            self._note_reachable()
             return False
+        except (urllib.error.URLError, OSError):
+            self._note_transport_failure()
+            return False
+        self._note_reachable()
+        return ok
 
     def ping(self) -> bool:
         """GET /ping (main.go:115-127)."""
@@ -160,11 +205,14 @@ class RemotePeer:
             ) as res:
                 body = res.read() if res.status == 200 else None
         except urllib.error.HTTPError as e:
+            self._note_reachable()  # served an error status: peer is UP
             if e.code == 404:
                 setattr(self, flag_attr, False)
             return None
         except (urllib.error.URLError, OSError):
+            self._note_transport_failure()
             return None
+        self._note_reachable()
         out = self._parse(body)
         if out is not None:
             setattr(self, flag_attr, True)
@@ -323,8 +371,16 @@ class NetworkAgent:
         self.set_node = set_node  # optional SetNode sibling: pulled together
         self.seq_node = seq_node  # optional SeqNode sibling: pulled together
         self.map_node = map_node  # optional MapNode sibling: pulled together
-        self.peers = [RemotePeer(u) for u in peer_urls]
         self.config = config or ClusterConfig()
+        self.peers = [
+            RemotePeer(
+                u,
+                timeout=self.config.peer_timeout_s,
+                backoff_base_s=self.config.peer_backoff_base_s,
+                backoff_cap_s=self.config.peer_backoff_cap_s,
+            )
+            for u in peer_urls
+        ]
         self.metrics = metrics or node.metrics
         # compaction-barrier scheduler: exactly ONE agent in the fleet may
         # coordinate (see network_compact's single-scheduler rule)
@@ -341,11 +397,20 @@ class NetworkAgent:
         separately through their *_gossip_* metrics and their own pull
         returns, so the surfaces' freshness is never conflated
         (/admin/pull's {"pulled"} and the soak's pulls counter are KV
-        facts)."""
+        facts).  With ``config.fuse_pull_k > 1`` the round instead pulls
+        k distinct peers concurrently and merges them in one dispatch
+        (_gossip_once_fused); peers inside a transport-failure backoff
+        window are skipped either way (_available_peers)."""
         if not self.peers:
             self.metrics.inc("net_gossip_skipped")
             return False
-        peer = self._rng.choice(self.peers)
+        avail = self._available_peers()
+        if not avail:
+            self.metrics.inc("net_gossip_skipped")
+            return False
+        if min(self.config.fuse_pull_k, len(avail)) > 1:
+            return self._gossip_once_fused(avail)
+        peer = self._rng.choice(avail)
         tid = mint_trace_id(self.node.rid)
         merged = pull_round(
             self.node,
@@ -359,6 +424,58 @@ class NetworkAgent:
         self.set_pull(peer)
         self.seq_pull(peer)
         self.map_pull(peer)
+        return merged
+
+    def _available_peers(self) -> List[RemotePeer]:
+        """Peers not inside a transport-failure backoff window.  Skips are
+        LOUD: each backed-off peer counts one ``net_peer_backoff_skips``
+        per round and an event, so an operator sees exactly how much of
+        the topology is being routed around (the reference would instead
+        stall the round at full timeout on every unreachable friend —
+        main.go:235-239 repays the connect timeout every 1500 ms)."""
+        avail = []
+        for p in self.peers:
+            if p.backed_off():
+                self.metrics.inc("net_peer_backoff_skips")
+                self.node.events.emit("peer_backoff_skip", peer=p.url,
+                                      failures=p.failures)
+            else:
+                avail.append(p)
+        return avail
+
+    def _gossip_once_fused(self, avail: List[RemotePeer]) -> bool:
+        """One k-way fused pull round (config.fuse_pull_k > 1): fetch up to
+        k distinct peers' delta payloads CONCURRENTLY against one pre-round
+        version vector, then merge every response in a single device
+        dispatch (fused_pull_round → ReplicaNode.receive_many).  The
+        sibling lattices pull per responding peer afterwards — their hosts
+        are pure-dict joins with no device dispatch to fuse."""
+        if not self.node.alive:
+            # match pull_round's dead-self accounting without fetching
+            return fused_pull_round(self.node, [], self.metrics,
+                                    delta=self.config.delta_gossip,
+                                    prefix="net_gossip")
+        k = min(self.config.fuse_pull_k, len(avail))
+        peers = self._rng.sample(avail, k)
+        tid = mint_trace_id(self.node.rid)
+        since = self.node.version_vector() if self.config.delta_gossip else None
+        with ThreadPoolExecutor(max_workers=k) as pool:
+            payloads = list(pool.map(
+                lambda p: p.gossip_payload(since, trace=tid), peers))
+        merged = fused_pull_round(
+            self.node,
+            [(p.url, body) for p, body in zip(peers, payloads)],
+            self.metrics,
+            delta=self.config.delta_gossip,
+            prefix="net_gossip",
+            trace=tid,
+        )
+        for peer, body in zip(peers, payloads):
+            if body is None:
+                continue  # unreachable this round: don't re-pay the timeout
+            self.set_pull(peer)
+            self.seq_pull(peer)
+            self.map_pull(peer)
         return merged
 
     def set_pull(self, peer: RemotePeer) -> bool:

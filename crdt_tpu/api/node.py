@@ -148,6 +148,66 @@ def pull_round(node: "ReplicaNode", fetch_payload, metrics, delta: bool,
         return True
 
 
+def fused_pull_round(node: "ReplicaNode", fetched, metrics, delta: bool,
+                     prefix: str = "gossip",
+                     trace: Optional[str] = None) -> bool:
+    """The k-way sibling of :func:`pull_round` — the pipelined merge
+    runtime's round body.  ``fetched`` is a list of ``(peer_label,
+    payload_or_None)`` pairs the driver already collected (concurrently in
+    NetworkAgent, in-process in LocalCluster), all requested against the
+    SAME pre-round version vector; every non-empty payload is merged in ONE
+    device dispatch via :meth:`ReplicaNode.receive_many`, so a P-peer round
+    costs 1 merge dispatch instead of P (pinned by the merge_dispatches
+    counter, tests/test_pipeline.py).
+
+    Per-peer skip/noop accounting matches the sequential path exactly: an
+    unreachable peer counts one ``{prefix}_skipped``, an empty delta one
+    ``{prefix}_noop``, and the lag gauges are observed per peer — only the
+    merge itself is fused.
+    """
+    lab = str(node.rid)
+    if not node.alive:
+        metrics.inc(f"{prefix}_skipped")
+        node.events.emit("pull_skip", trace=trace, reason="down")
+        return False
+    with span(f"crdt.fused_pull_round.{prefix}", trace) as tid:
+        payloads, labels, total_ops = [], [], 0
+        for peer, payload in fetched:
+            if payload is None:
+                metrics.inc(f"{prefix}_skipped")
+                node.events.emit("pull_skip", trace=tid, peer=peer,
+                                 reason="peer_unreachable")
+                continue
+            n_ops = sum(
+                1 for k in payload if k not in (FRONTIER_KEY, SUMMARY_KEY)
+            )
+            if delta:
+                health.observe_pull_lag(metrics.registry, lab,
+                                        peer or "?", n_ops)
+            if not payload:  # delta mode: this peer had nothing we lack
+                metrics.inc(f"{prefix}_noop")
+                node.events.emit("pull_noop", trace=tid, peer=peer)
+                continue
+            payloads.append(payload)
+            labels.append(peer)
+            total_ops += n_ops
+        if not payloads:
+            return False
+        health.observe_fused_pull(metrics.registry, lab, len(payloads))
+        metrics.inc(f"{prefix}_payload_ops", total_ops)
+        fresh = node.receive_many(payloads)
+        if not fresh:  # every payload was re-deliveries
+            metrics.inc(f"{prefix}_noop")
+            node.events.emit("pull_noop", trace=tid, peers=labels,
+                             ops=total_ops)
+            return False
+        metrics.inc(f"{prefix}_rounds")
+        health.mark_merge(metrics.registry, lab)
+        node.events.emit("pull_merge_fused", trace=tid, peers=labels,
+                         ops=total_ops, fresh=fresh)
+        return True
+
+
 class ReplicaNode:
     def __init__(
         self,
@@ -407,18 +467,15 @@ class ReplicaNode:
             payload = self._payload_locked(since)
         return json.dumps(payload).encode()
 
-    def receive(self, payload: Optional[Dict[str, Any]]) -> int:
-        """Pull-side merge of a peer's gossip payload (main.go:250-257);
-        returns the number of genuinely new ops absorbed (0 = the payload
-        taught us nothing — re-deliveries and already-folded ops dedup).
-        Unknown strings are interned locally; a malformed key raises
-        ValueError (the reference silently killed its gossip loop forever,
-        quirk §0.1.8 — failing loudly is the fix)."""
-        if not payload or not self.alive:
-            return 0
+    def _decode_payload(self, payload: Dict[str, Any]):
+        """Wire payload -> (remote_frontier, remote_summary, op rows),
+        timestamps rebased onto this node's int32 window.  A malformed key
+        raises ValueError (the reference silently killed its gossip loop
+        forever, quirk §0.1.8 — failing loudly is the fix)."""
         payload = dict(payload)
         remote_frontier = {
-            int(r): int(s) for r, s in (payload.pop(FRONTIER_KEY, None) or {}).items()
+            int(r): int(s)
+            for r, s in (payload.pop(FRONTIER_KEY, None) or {}).items()
         }
         remote_summary = payload.pop(SUMMARY_KEY, None) or {}
         epoch = self.clock.epoch_ms
@@ -435,6 +492,16 @@ class ReplicaNode:
                     "kill gossip silently — here it fails loudly"
                 )
             rows.append((ts, rid, seq, cmd))
+        return remote_frontier, remote_summary, rows
+
+    def receive(self, payload: Optional[Dict[str, Any]]) -> int:
+        """Pull-side merge of a peer's gossip payload (main.go:250-257);
+        returns the number of genuinely new ops absorbed (0 = the payload
+        taught us nothing — re-deliveries and already-folded ops dedup).
+        Unknown strings are interned locally."""
+        if not payload or not self.alive:
+            return 0
+        remote_frontier, remote_summary, rows = self._decode_payload(payload)
         with self._lock:
             with self.metrics.timer("merge"), span("crdt.merge"):
                 adopted = 0
@@ -443,6 +510,39 @@ class ReplicaNode:
                         remote_frontier, remote_summary
                     )
                 return self._ingest(rows) + adopted
+
+    def receive_many(self, payloads: List[Dict[str, Any]]) -> int:
+        """K-way FUSED merge: absorb several peers' gossip payloads in ONE
+        device merge dispatch (the pipelined merge runtime's pull-side; see
+        :func:`fused_pull_round`).
+
+        Bit-exact against merging the payloads one ``receive`` at a time in
+        any order: the op union is ACI (identical idents dedup in _accept,
+        the ingest batch is canonically re-sorted by from_ops/merge), and
+        compaction frontiers on a correctly-deployed fleet form a chain, so
+        adopting them in payload order lands on the same maximal fold.  The
+        fusion only changes HOW MANY device dispatches the round costs:
+        one ``_ingest`` (one sorted-union dispatch) for all P payloads
+        instead of P.
+        """
+        if not self.alive:
+            return 0
+        decoded = [
+            self._decode_payload(p) for p in payloads if p
+        ]
+        if not decoded:
+            return 0
+        with self._lock:
+            with self.metrics.timer("merge"), span("crdt.merge_fused"):
+                adopted = 0
+                rows_all: List[Tuple[int, int, int, Dict[str, str]]] = []
+                for remote_frontier, remote_summary, rows in decoded:
+                    if remote_frontier:
+                        adopted += self._adopt_frontier_locked(
+                            remote_frontier, remote_summary
+                        )
+                    rows_all.extend(rows)
+                return self._ingest(rows_all) + adopted
 
     # ---- health / fault injection ----
 
@@ -759,7 +859,13 @@ class ReplicaNode:
         while needed > self.log.capacity:
             self._grow()
         batch_cap = max(fresh, 1)
-        merged, n_unique = oplog.merge_checked(
+        # ONE device dispatch per ingest batch, however many peers' rows it
+        # fuses (receive_many) — the counter the dispatch-count assertions
+        # pin (crdt_merge_dispatches_total on /metrics).  The self log is
+        # donated: it is rebound right below under the node lock, so XLA
+        # may write the union into its buffers (TPU/GPU; plain jit on CPU).
+        self.metrics.inc("merge_dispatches")
+        merged, n_unique = oplog.merge_checked_donating(
             self.log, oplog.from_ops(batch_cap, ops)
         )
         assert int(n_unique) <= self.log.capacity

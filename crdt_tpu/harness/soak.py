@@ -356,6 +356,10 @@ def main(argv=None) -> int:
                     help="ALSO run scheduled barriers every N ticks")
     ap.add_argument("--full-gossip", action="store_true",
                     help="ship full logs every round instead of deltas")
+    ap.add_argument("--fuse-k", type=int, default=1,
+                    help="k-way fused pull rounds (ClusterConfig.fuse_pull_k):"
+                         " each round merges k peers' payloads in ONE device"
+                         " dispatch; 1 = reference single-peer rounds")
     ap.add_argument("--network", action="store_true",
                     help="run the soak over real sockets (NetworkSoakRunner)")
     ap.add_argument("--platform", choices=["cpu", "tpu", "ambient"],
@@ -376,7 +380,8 @@ def main(argv=None) -> int:
         if args.network:
             runner = NetworkSoakRunner(
                 n=args.replicas, seed=seed,
-                config=ClusterConfig(delta_gossip=not args.full_gossip),
+                config=ClusterConfig(delta_gossip=not args.full_gossip,
+                                     fuse_pull_k=args.fuse_k),
             )
             report = runner.run(args.steps)
         else:
@@ -385,6 +390,7 @@ def main(argv=None) -> int:
                     n_replicas=args.replicas,
                     compact_every=args.compact_every,
                     delta_gossip=not args.full_gossip,
+                    fuse_pull_k=args.fuse_k,
                 ),
                 seed=seed,
             )

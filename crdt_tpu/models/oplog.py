@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from crdt_tpu.ops import joins as _joins
 from crdt_tpu.ops import sorted_union as su
 from crdt_tpu.utils.constants import SENTINEL
 
@@ -147,10 +148,7 @@ def merge(local: OpLog, remote: OpLog) -> OpLog:
     return out
 
 
-@jax.jit
-def merge_checked(local: OpLog, remote: OpLog):
-    """merge returning (OpLog, n_unique): n_unique > local.capacity means the
-    true union overflowed and the newest ops were dropped."""
+def _merge_checked(local: OpLog, remote: OpLog):
     keys, vals, n_unique = su.sorted_union(
         (local.ts, local.rid, local.seq, local.key),
         {"val": local.val, "payload": local.payload, "is_num": local.is_num},
@@ -166,6 +164,21 @@ def merge_checked(local: OpLog, remote: OpLog):
         ),
         n_unique,
     )
+
+
+merge_checked = jax.jit(_merge_checked)
+merge_checked.__doc__ = """merge returning (OpLog, n_unique): n_unique >
+local.capacity means the true union overflowed and the newest ops were
+dropped."""
+
+# The host-ingest variant: donates ``local``'s plane buffers (joins.donating
+# — TPU/GPU only; plain jit on CPU) so XLA reuses them for the union output
+# instead of writing a fresh 7-plane log every merge.  ONLY for callers
+# that drop their reference to ``local`` at the call site — ReplicaNode
+# ._ingest rebinds self.log under the node lock (checkpoint saves take the
+# same lock, so no thread can read the deleted buffers).  Semantics are
+# pinned bit-exact to merge_checked by the lattice-law and parity suites.
+merge_checked_donating = _joins.donating(_merge_checked, argnums=(0,))
 
 
 @partial(jax.jit, static_argnames="n_writers")

@@ -49,6 +49,35 @@ def mark_merge(registry, node_label: str) -> None:
     registry.set_gauge("last_merge_unixtime", time.time(), node=node_label)
 
 
+def observe_fused_pull(registry, node_label: str, n_peers: int) -> None:
+    """Record one k-way fused pull round (crdt_tpu.api.node
+    .fused_pull_round): ``pull_round_peers_fused`` counts peers whose
+    payloads were merged in a single device dispatch, and the fan-out
+    gauge shows the latest round's width.  Together with
+    ``merge_dispatches_total`` (counted at the ingest dispatch itself,
+    ReplicaNode._ingest) this makes the dispatches-per-round ratio of the
+    pipelined merge runtime directly scrapeable."""
+    registry.inc("pull_round_peers_fused", n_peers, node=node_label)
+    registry.set_gauge("pull_fused_fanout", n_peers, node=node_label)
+
+
+def observe_pipeline(registry, pipeline: str, occupancy: float,
+                     stripes: int, stage_s: float, wait_s: float) -> None:
+    """Record one double-buffered stripe-pipeline run (crdt_tpu.parallel
+    .pipeline.run_striped): ``pipeline_occupancy`` is the share of the
+    dispatch-to-block window the host spent staging the next stripe's
+    operands instead of idling in block_until_ready (0.0 = fully serial:
+    every stage ran with the device idle), plus the raw stage/wait second
+    counters it is derived from."""
+    registry.set_gauge("pipeline_occupancy", round(occupancy, 4),
+                       pipeline=pipeline)
+    registry.inc("pipeline_stripes", stripes, pipeline=pipeline)
+    registry.inc("pipeline_stage_seconds", round(stage_s, 6),
+                 pipeline=pipeline)
+    registry.inc("pipeline_wait_seconds", round(wait_s, 6),
+                 pipeline=pipeline)
+
+
 def sample_kv_node(registry, node) -> None:
     """KV replica population + frontier gauges (ReplicaNode)."""
     lab = str(node.rid)

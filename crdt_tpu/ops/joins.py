@@ -23,6 +23,43 @@ def batched(join_fn: Callable) -> Callable:
     return jax.vmap(join_fn)
 
 
+# backends where XLA buffer donation is implemented; elsewhere (CPU) a
+# donate_argnums jit emits a "donated buffers were not usable" warning per
+# call and aliases nothing, so donation is disabled rather than noisy
+_DONATING_BACKENDS = ("tpu", "gpu")
+
+
+def donating(join_fn: Callable, argnums=(0,)) -> Callable:
+    """Jit ``join_fn`` donating the ``argnums`` operands' buffers (the
+    self-plane of a hot join) so XLA writes the result in place instead of
+    allocating + writing a fresh output plane — on the streaming lattices
+    that is one full HBM write-back saved per host-path merge.
+
+    Donation rule (see PERF.md "Dispatch-bound layer"): an argument may be
+    donated ONLY when the caller provably drops every reference to it after
+    the call — e.g. ReplicaNode._ingest rebinds ``self.log`` to the result
+    under the node lock, and the striped drivers consume each stripe's
+    operands exactly once.  Callers that reuse an operand across calls
+    (rep-timed benches, the ACI law tests joining ``a`` twice) must use a
+    plain jit instead: a donated buffer is DELETED at dispatch and a second
+    use raises.
+
+    The jit is built lazily per backend: donation only engages on backends
+    that implement aliasing (TPU/GPU); on CPU this is exactly ``jax.jit``.
+    """
+    compiled = {}
+
+    def call(*args, **kwargs):
+        backend = jax.default_backend()
+        fn = compiled.get(backend)
+        if fn is None:
+            donate = argnums if backend in _DONATING_BACKENDS else ()
+            fn = compiled[backend] = jax.jit(join_fn, donate_argnums=donate)
+        return fn(*args, **kwargs)
+
+    return call
+
+
 def _leading_dim(state: Any) -> int:
     return jax.tree.leaves(state)[0].shape[0]
 

@@ -69,6 +69,23 @@ class ClusterConfig:
     # collapse last-writer-per-ms (§0.1.2).  Requires compact_every=0 and
     # (for crdt_tpu peers) delta_gossip=True — see crdt_tpu.api.node.
     go_compat_gossip: bool = False
+    # k-way FUSED pull rounds (pipelined merge runtime): each round pulls
+    # from min(k, peers) DISTINCT peers concurrently and merges every
+    # fetched payload in ONE device dispatch (ReplicaNode.receive_many) —
+    # a P-peer round costs 1 merge dispatch instead of P.  1 = the
+    # reference's one-random-peer round (main.go:230), the default so
+    # seeded schedules replay unchanged.
+    fuse_pull_k: int = 1
+    # per-peer HTTP timeout for the network agent's RemotePeer clients
+    peer_timeout_s: float = 5.0
+    # exponential per-peer backoff after a TRANSPORT failure (connection
+    # refused / socket timeout): the peer is skipped — loudly, counted
+    # under net_peer_backoff_skips — until the deadline, so one
+    # unreachable peer cannot stall every round at full peer_timeout_s.
+    # A reachable-but-down peer (served 502) responds instantly and is
+    # NOT backed off: it costs the round nothing and may revive any time.
+    peer_backoff_base_s: float = 0.5
+    peer_backoff_cap_s: float = 30.0
 
     def ports(self) -> List[int]:
         return [self.base_port + i for i in range(self.n_replicas)]
