@@ -372,16 +372,22 @@ def _enable_compile_cache():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
-def _orset_union_rate(seed, c, ln, tiny, bank_n=None, chained_fn_cache={}):
+_CHAINED_FN_CACHE: dict = {}  # (c, ln, bank_n, interpret, donate) -> jitted chain
+
+
+def _orset_union_rate(seed, c, ln, tiny, bank_n=None, chained_fn_cache=None):
     """Measured per-union seconds for a C-tag x ln-lane columnar union
     (None off-TPU after an interpret-mode smoke union).  Shared by the
     single-shape bench, the lane sweep, and the 1M striped driver.
 
-    ``chained_fn_cache`` (intentionally shared across calls) holds ONE
+    ``chained_fn_cache`` defaults to the shared module-level cache: ONE
     jitted chain per (c, ln, bank_n) so the 8-stripe 1M driver compiles
     once, not once per stripe."""
     import jax
     import jax.numpy as jnp
+
+    if chained_fn_cache is None:
+        chained_fn_cache = _CHAINED_FN_CACHE
 
     _enable_compile_cache()
 

@@ -99,12 +99,17 @@ def _stripe_driver(stripes, cap, fill, seed, pipelined, registry=None,
     return results, stats, time.perf_counter() - t0
 
 
-def _union_fn(cap, _cache={}):
+_UNION_FN_CACHE: dict = {}  # cap -> jitted union, shared by both arms
+
+
+def _union_fn(cap, _cache=None):
     """One jitted union per capacity (shared by both arms and all reps)."""
     import jax
 
     from crdt_tpu.ops import sorted_union
 
+    if _cache is None:
+        _cache = _UNION_FN_CACHE
     if cap not in _cache:
         @jax.jit
         def union(ka, va, kb, vb):

@@ -1,0 +1,139 @@
+"""crdtlint: project-specific static analysis for the TPU-CRDT codebase.
+
+Three layers, one gate (``python -m crdt_tpu.analysis``):
+
+* AST checkers (ast_checks) — the JAX hazards that bite THIS system:
+  donated-buffer reuse, jit/pallas_call construction in per-round loops
+  (silent recompilation), blocking host syncs in the hot-path packages,
+  and ``except Exception`` blocks that swallow without telling anyone.
+* Jaxpr checkers (jaxpr_checks) — every join in the ops/joins.py
+  registry is traced abstractly and asserted callback-free, aval-closed,
+  and (where claimed) operand-swap symmetric: the static half of the ACI
+  story whose runtime half is tests/test_lattice_laws.py.
+* Concurrency lint (concurrency) — shared mutable state written from
+  thread-reachable code without a lock, over a conservative name-based
+  call graph seeded at ``threading.Thread`` targets and executor
+  submissions.
+
+Findings carry file:line, severity, and a drift-stable fingerprint; the
+committed suppressions file (analysis/baseline.json) lets the gate start
+green on a 15k-LoC codebase and ratchet from there (baseline module).
+"""
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from typing import Iterable, List, Optional
+
+SEV_ERROR = "error"
+SEV_WARN = "warn"
+
+#: every rule the suite implements, with a one-line summary (the CLI's
+#: --rules filter and the docs both read from here)
+RULES = {
+    "CRDT001": "donation-after-use: a buffer donated to a jitted call is read again",
+    "CRDT002": "jit/pallas_call constructed inside a loop (recompile trap)",
+    "CRDT003": "blocking host sync (.item()/np.asarray/float()) in a hot-path package",
+    "CRDT004": "except Exception swallows silently (no raise/log/handling)",
+    "CRDT101": "registered join traces a callback primitive (impure jaxpr)",
+    "CRDT102": "registered join is not aval-closed (out avals != self avals)",
+    "CRDT103": "join claimed structurally commutative has asymmetric jaxpr",
+    "CRDT201": "shared mutable state written from thread-reachable code without a lock",
+}
+
+SEVERITY = {
+    "CRDT001": SEV_ERROR,
+    "CRDT002": SEV_WARN,
+    "CRDT003": SEV_WARN,
+    "CRDT004": SEV_ERROR,
+    "CRDT101": SEV_ERROR,
+    "CRDT102": SEV_ERROR,
+    "CRDT103": SEV_ERROR,
+    "CRDT201": SEV_WARN,
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint finding.  ``scope`` (enclosing def/class qualname) and
+    ``detail`` (a line-number-free payload: normalized source text or the
+    offending name) feed the fingerprint, so findings survive unrelated
+    line drift without churning the baseline."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    scope: str = ""
+    detail: str = ""
+    col: int = 0
+
+    @property
+    def severity(self) -> str:
+        return SEVERITY.get(self.rule, SEV_WARN)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "scope": self.scope,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        return f"{where}: {self.rule} {self.severity}:{scope} {self.message}"
+
+
+def package_root() -> pathlib.Path:
+    """The crdt_tpu package directory (the default analysis target)."""
+    return pathlib.Path(__file__).resolve().parent.parent
+
+
+def repo_root() -> pathlib.Path:
+    return package_root().parent
+
+
+def iter_py_files(roots: Iterable[pathlib.Path]) -> List[pathlib.Path]:
+    out: List[pathlib.Path] = []
+    for root in roots:
+        if root.is_file():
+            out.append(root)
+            continue
+        for p in sorted(root.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            out.append(p)
+    return out
+
+
+def run_all(roots: Optional[Iterable[pathlib.Path]] = None, *,
+            jaxpr: bool = True,
+            rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run every layer over ``roots`` (default: the crdt_tpu package).
+
+    ``jaxpr=False`` skips the join-trace layer (it imports jax + the model
+    modules; the AST layers need only the standard library).  ``rules``
+    filters to a subset of rule IDs.
+    """
+    from crdt_tpu.analysis import ast_checks, concurrency
+
+    root_list = list(roots) if roots is not None else [package_root()]
+    rel_base = repo_root()
+    findings: List[Finding] = []
+    files = iter_py_files(root_list)
+    findings.extend(ast_checks.check_files(files, rel_base))
+    findings.extend(concurrency.check_files(files, rel_base))
+    if jaxpr:
+        from crdt_tpu.analysis import jaxpr_checks
+
+        findings.extend(jaxpr_checks.check_registered_joins(rel_base))
+    if rules is not None:
+        keep = set(rules)
+        findings = [f for f in findings if f.rule in keep]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

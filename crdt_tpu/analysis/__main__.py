@@ -1,0 +1,96 @@
+"""crdtlint CLI: ``python -m crdt_tpu.analysis``.
+
+Modes
+    (default)            run all layers, print findings, exit 1 if any
+    --check-baseline     exit 0 iff nothing NEW vs analysis/baseline.json
+                         (the CI gate; stale entries are reported but pass)
+    --write-baseline     regenerate the baseline from the current tree
+    --json               machine-readable output (findings + fingerprints)
+    --no-jaxpr           AST/concurrency layers only (no jax import)
+    --rules CRDT001,...  restrict to a rule subset
+    PATHS                files or directories (default: the crdt_tpu package)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from crdt_tpu import analysis
+from crdt_tpu.analysis import RULES, baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m crdt_tpu.analysis",
+        description="crdtlint: JAX-hazard + concurrency static analysis "
+                    "with a ratcheting baseline gate.",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: crdt_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--check-baseline", action="store_true",
+                    help="exit 0 iff no findings outside the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the suppressions file from this tree")
+    ap.add_argument("--baseline", type=pathlib.Path,
+                    default=baseline.DEFAULT_BASELINE)
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip the join-trace layer (no jax import)")
+    ap.add_argument("--rules", type=str, default=None,
+                    help="comma-separated rule subset (e.g. CRDT001,CRDT201)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  [{analysis.SEVERITY.get(rule, 'warn'):5s}]  {desc}")
+        return 0
+
+    roots = [pathlib.Path(p) for p in args.paths] or None
+    rules = args.rules.split(",") if args.rules else None
+    findings = analysis.run_all(roots, jaxpr=not args.no_jaxpr, rules=rules)
+
+    if args.write_baseline:
+        n = baseline.save(findings, args.baseline)
+        print(f"crdtlint: wrote {n} baseline entr{'y' if n == 1 else 'ies'} "
+              f"to {args.baseline}")
+        return 0
+
+    if args.check_baseline:
+        new, stale = baseline.diff(findings, args.baseline)
+        if args.as_json:
+            print(json.dumps({
+                "new": [dict(f.to_dict(), fingerprint=fp)
+                        for f, fp in baseline.fingerprints(new)],
+                "stale": stale,
+                "total": len(findings),
+            }, indent=1))
+        else:
+            for f in new:
+                print(f.render())
+            for e in stale:
+                print(f"crdtlint: stale baseline entry {e['fingerprint']} "
+                      f"({e['rule']} {e['path']} {e.get('scope', '')}) — "
+                      f"fixed? ratchet it out with --write-baseline")
+            print(f"crdtlint: {len(findings)} finding(s), {len(new)} new, "
+                  f"{len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'}")
+        return 1 if new else 0
+
+    if args.as_json:
+        print(json.dumps(
+            [dict(f.to_dict(), fingerprint=fp)
+             for f, fp in baseline.fingerprints(findings)], indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        errors = sum(1 for f in findings if f.severity == "error")
+        print(f"crdtlint: {len(findings)} finding(s) ({errors} error)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

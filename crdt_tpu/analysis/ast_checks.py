@@ -1,0 +1,374 @@
+"""AST-level checkers: the JAX hazards this codebase actually hits.
+
+All four rules are pure-stdlib (ast only) and per-file; whole-package
+reachability lives in crdt_tpu.analysis.concurrency.
+
+CRDT001 donation-after-use (error)
+    A name passed at a donated position of a ``joins.donating(...)`` /
+    ``jax.jit(..., donate_argnums=...)`` call site and read afterwards in
+    the same scope.  A donated buffer is DELETED at dispatch; the second
+    read raises ``BufferDonationError`` on TPU/GPU — and silently works
+    on CPU, which is exactly why it must be caught statically (the CI
+    backend would never see it).
+
+CRDT002 jit-in-loop (warn)
+    ``jax.jit`` / ``pl.pallas_call`` constructed lexically inside a
+    ``for``/``while`` body (including via decorator on a def inside a
+    loop).  Each construction is a fresh callable with an empty compile
+    cache: per-round construction recompiles every round.
+
+CRDT003 host-sync (warn, hot-path packages only)
+    ``.item()``, ``np.asarray(...)``, ``jax.device_get(...)`` or
+    ``float(<call/attr>)`` inside crdt_tpu/{ops,models,parallel}: each is
+    a device→host round-trip that serializes the async dispatch stream.
+    Intentional host-path materializations are baselined, not exempted —
+    new ones must be triaged.
+
+CRDT004 silent-except (error)
+    ``except Exception``/``except BaseException``/bare ``except`` whose
+    body neither re-raises, nor calls anything (no ``obs.events`` emit,
+    no logging, no metrics, no HTTP error response), nor records the
+    failure in an assignment.  ``__del__`` finalizers are exempt (they
+    must never raise).
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from crdt_tpu.analysis import Finding
+
+#: packages whose files are on the device-dispatch hot path (CRDT003)
+HOT_PACKAGES = ("crdt_tpu/ops/", "crdt_tpu/models/", "crdt_tpu/parallel/")
+
+_JIT_NAMES = {"jit", "pallas_call"}
+
+
+def _relpath(path: pathlib.Path, base: pathlib.Path) -> str:
+    try:
+        return path.resolve().relative_to(base).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def _callee_name(func: ast.AST) -> str:
+    """Trailing name of a call target: ``jax.jit`` → 'jit', ``jit`` → 'jit'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _src_of(node: ast.AST, lines: List[str]) -> str:
+    ln = getattr(node, "lineno", 0)
+    if 1 <= ln <= len(lines):
+        return lines[ln - 1].strip()
+    return ""
+
+
+class _Scope:
+    """One function (or module) body analyzed for donation-after-use."""
+
+    def __init__(self, qualname: str):
+        self.qualname = qualname
+        # name -> donated argnums, for names bound to donating callables
+        self.donating_fns: Dict[str, Tuple[int, ...]] = {}
+        # name -> line it was donated at
+        self.consumed: Dict[str, int] = {}
+
+
+def _donate_argnums_of_call(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """If ``call`` constructs a donating callable, the donated argnums.
+
+    Recognized constructors: ``donating(f)`` / ``joins.donating(f)`` (with
+    an optional literal ``argnums`` second arg/kwarg, default ``(0,)``)
+    and ``jax.jit(f, donate_argnums=...)`` with a literal int/tuple.
+    """
+    name = _callee_name(call.func)
+    if name == "donating":
+        spec = None
+        if len(call.args) >= 2:
+            spec = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "argnums":
+                spec = kw.value
+        return _literal_argnums(spec, default=(0,))
+    if name == "jit":
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                return _literal_argnums(kw.value, default=None)
+    return None
+
+
+def _literal_argnums(node: Optional[ast.AST],
+                     default: Optional[Tuple[int, ...]]) -> Optional[Tuple[int, ...]]:
+    if node is None:
+        return default
+    try:
+        val = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return default
+    if isinstance(val, int):
+        return (val,)
+    if isinstance(val, (tuple, list)) and all(isinstance(v, int) for v in val):
+        return tuple(val)
+    return default
+
+
+def check_donation_after_use(tree: ast.Module, lines: List[str],
+                             relpath: str) -> List[Finding]:
+    """CRDT001 over every def in the file (module-level donating bindings
+    are visible inside defs, matching Python scoping)."""
+    findings: List[Finding] = []
+    module_donating: Dict[str, Tuple[int, ...]] = {}
+
+    # pass 1: module-level `merge = donating(join)` style bindings
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            nums = _donate_argnums_of_call(stmt.value)
+            if nums:
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        module_donating[tgt.id] = nums
+
+    def scan_scope(body: List[ast.stmt], qualname: str,
+                   inherited: Dict[str, Tuple[int, ...]]) -> None:
+        donating_fns = dict(inherited)
+        consumed: Dict[str, Tuple[int, str]] = {}  # name -> (line, src)
+
+        class V(ast.NodeVisitor):
+            def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+                scan_scope(node.body, f"{qualname}.{node.name}".lstrip("."),
+                           donating_fns)
+
+            visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+            def visit_Assign(self, node: ast.Assign) -> None:
+                if isinstance(node.value, ast.Call):
+                    nums = _donate_argnums_of_call(node.value)
+                    if nums:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                donating_fns[tgt.id] = nums
+                # visit the RHS first (it may consume operands), THEN
+                # clear the targets: `a = merge(a, b)` rebinds `a` to the
+                # merge OUTPUT, which is live even though the old `a` was
+                # donated
+                self.generic_visit(node)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        consumed.pop(tgt.id, None)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                self.generic_visit(node)
+                nums: Optional[Tuple[int, ...]] = None
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in donating_fns:
+                    nums = donating_fns[node.func.id]
+                elif isinstance(node.func, ast.Call):
+                    # direct `donating(f)(a, b)` / `jax.jit(f, ...)(a, b)`
+                    nums = _donate_argnums_of_call(node.func)
+                if not nums:
+                    return
+                for i in nums:
+                    if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                        arg = node.args[i]
+                        consumed[arg.id] = (node.lineno, _src_of(node, lines))
+
+            def visit_Name(self, node: ast.Name) -> None:
+                if isinstance(node.ctx, ast.Load) and node.id in consumed:
+                    don_line, _src = consumed[node.id]
+                    if node.lineno > don_line:
+                        findings.append(Finding(
+                            rule="CRDT001", path=relpath, line=node.lineno,
+                            col=node.col_offset, scope=qualname,
+                            detail=f"{node.id}|{_src_of(node, lines)}",
+                            message=(
+                                f"`{node.id}` was donated at line {don_line} "
+                                f"and is read again — a donated buffer is "
+                                f"deleted at dispatch (TPU/GPU raise; CPU "
+                                f"silently aliases nothing)"),
+                        ))
+                        consumed.pop(node.id, None)  # one finding per donation
+
+        # visit statements in order so lineno comparisons are meaningful
+        v = V()
+        for stmt in body:
+            v.visit(stmt)
+
+    scan_scope(tree.body, "", module_donating)
+    return findings
+
+
+def check_jit_in_loop(tree: ast.Module, lines: List[str],
+                      relpath: str) -> List[Finding]:
+    """CRDT002: jit/pallas_call constructed under a for/while."""
+    findings: List[Finding] = []
+
+    def walk(node: ast.AST, loop_depth: int, qualname: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            depth = loop_depth
+            qn = qualname
+            if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                depth += 1
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{qualname}.{child.name}".lstrip(".")
+                if loop_depth > 0:
+                    for dec in child.decorator_list:
+                        target = dec.func if isinstance(dec, ast.Call) else dec
+                        if _callee_name(target) in _JIT_NAMES:
+                            findings.append(Finding(
+                                rule="CRDT002", path=relpath,
+                                line=child.lineno, col=child.col_offset,
+                                scope=qn, detail=_src_of(dec, lines) or child.name,
+                                message=(f"@{_callee_name(target)} on a def "
+                                         f"inside a loop: each iteration "
+                                         f"builds a fresh compile cache"),
+                            ))
+            if isinstance(child, ast.Call) and loop_depth > 0 \
+                    and _callee_name(child.func) in _JIT_NAMES:
+                findings.append(Finding(
+                    rule="CRDT002", path=relpath, line=child.lineno,
+                    col=child.col_offset, scope=qualname,
+                    detail=_src_of(child, lines),
+                    message=(f"{_callee_name(child.func)}(...) constructed "
+                             f"inside a loop: a fresh callable recompiles "
+                             f"every iteration (hoist it, or cache per "
+                             f"static shape)"),
+                ))
+            walk(child, depth, qn)
+
+    walk(tree, 0, "")
+    return findings
+
+
+def check_host_sync(tree: ast.Module, lines: List[str],
+                    relpath: str) -> List[Finding]:
+    """CRDT003, only inside the hot-path packages."""
+    if not any(relpath.startswith(p) for p in HOT_PACKAGES):
+        return []
+    findings: List[Finding] = []
+
+    def qualnames() -> Dict[int, str]:
+        # map every node id to its enclosing def qualname
+        owner: Dict[int, str] = {}
+
+        def mark(node: ast.AST, qn: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                cqn = qn
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cqn = f"{qn}.{child.name}".lstrip(".")
+                owner[id(child)] = cqn
+                mark(child, cqn)
+
+        mark(tree, "")
+        return owner
+
+    owner = qualnames()
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        msg = None
+        if isinstance(func, ast.Attribute) and func.attr == "item" \
+                and not node.args and not node.keywords:
+            msg = ".item() blocks on the device stream (one host round-trip)"
+        elif isinstance(func, ast.Attribute) and func.attr == "asarray" \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in ("np", "numpy"):
+            msg = "np.asarray on a device value synchronizes the dispatch stream"
+        elif isinstance(func, ast.Attribute) and func.attr == "device_get":
+            msg = "jax.device_get is an explicit device→host sync"
+        elif isinstance(func, ast.Name) and func.id == "float" \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], (ast.Call, ast.Attribute)):
+            msg = "float(...) on a computed value forces a device sync"
+        if msg:
+            findings.append(Finding(
+                rule="CRDT003", path=relpath, line=node.lineno,
+                col=node.col_offset, scope=owner.get(id(node), ""),
+                detail=_src_of(node, lines),
+                message=msg + " — keep it off the per-round path or baseline it",
+            ))
+    return findings
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [_callee_name(e) for e in t.elts]
+    else:
+        names = [_callee_name(t)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def check_silent_except(tree: ast.Module, lines: List[str],
+                        relpath: str) -> List[Finding]:
+    """CRDT004: broad handlers whose body provably does nothing with the
+    failure: no raise, no call of any kind, no assignment."""
+    findings: List[Finding] = []
+
+    def scan(node: ast.AST, qualname: str, in_del: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            qn, child_in_del = qualname, in_del
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{qualname}.{child.name}".lstrip(".")
+                child_in_del = child.name == "__del__"
+            if isinstance(child, ast.ExceptHandler) and not child_in_del \
+                    and _is_broad_handler(child):
+                handled = False
+                for n in ast.walk(ast.Module(body=child.body, type_ignores=[])):
+                    if isinstance(n, (ast.Raise, ast.Call, ast.Assign,
+                                      ast.AugAssign, ast.AnnAssign)):
+                        handled = True
+                        break
+                if not handled:
+                    findings.append(Finding(
+                        rule="CRDT004", path=relpath, line=child.lineno,
+                        col=child.col_offset, scope=qualname,
+                        detail=_src_of(child, lines),
+                        message=("broad except swallows silently — narrow "
+                                 "the exception type or record it "
+                                 "(obs.events.emit / metrics / re-raise)"),
+                    ))
+            scan(child, qn, child_in_del)
+
+    scan(tree, "", False)
+    return findings
+
+
+ALL_CHECKS = (
+    check_donation_after_use,
+    check_jit_in_loop,
+    check_host_sync,
+    check_silent_except,
+)
+
+
+def check_file(path: pathlib.Path, rel_base: pathlib.Path) -> List[Finding]:
+    relpath = _relpath(path, rel_base)
+    try:
+        src = path.read_text(encoding="utf-8")
+        tree = ast.parse(src)
+    except (OSError, SyntaxError) as e:
+        return [Finding(rule="CRDT000", path=relpath, line=1,
+                        message=f"unparseable: {e}", detail=str(e))]
+    lines = src.splitlines()
+    findings: List[Finding] = []
+    for check in ALL_CHECKS:
+        findings.extend(check(tree, lines, relpath))
+    return findings
+
+
+def check_files(paths: Iterable[pathlib.Path],
+                rel_base: pathlib.Path) -> List[Finding]:
+    out: List[Finding] = []
+    for p in paths:
+        out.extend(check_file(p, rel_base))
+    return out

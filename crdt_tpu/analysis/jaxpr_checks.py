@@ -1,0 +1,171 @@
+"""Jaxpr-level checks over the ops/joins.py join registry.
+
+Every lattice join the package exports (crdt_tpu.ops.joins.registered_joins)
+is traced with abstract operands and statically audited:
+
+CRDT101 purity
+    The traced jaxpr (recursively, through pjit/closed-call sub-jaxprs)
+    contains no callback primitive (``pure_callback``, ``io_callback``,
+    ``debug_callback``, ...).  A callback inside a join would smuggle
+    host state into the lattice algebra — merges would stop being pure
+    functions of their operands, breaking every ACI argument downstream
+    (and donation/fusion along with it).
+
+CRDT102 aval closure
+    The output avals (shape + dtype, per pytree leaf) equal the first
+    operand's avals.  Joins must be endomorphisms: ``join : S × S → S``
+    on the SAME array layout, or tree_reduce_join/converge and the
+    donation rule (in-place aliasing needs matching layouts) are unsound.
+
+CRDT103 swap symmetry (only where claimed)
+    For joins registered ``structurally_commutative=True``, the jaxpr of
+    ``join(a, b)`` must equal the jaxpr of ``join(b, a)`` after
+    canonicalizing operand order of commutative primitives.  This is the
+    static ACI smoke: a refactor that sneaks an asymmetric select into a
+    pointwise-max lattice fails CI before the runtime law tests run a
+    single value.  (Select-based joins are extensionally commutative but
+    not operand-symmetric — they claim False and are covered by
+    tests/test_lattice_laws.py instead.)
+"""
+from __future__ import annotations
+
+import pathlib
+from typing import List
+
+from crdt_tpu.analysis import Finding
+
+#: primitives that execute host code mid-jaxpr (substring match on the
+#: primitive name, so new callback flavors are caught by default)
+_CALLBACK_MARKERS = ("callback",)
+
+#: primitives whose operand order is semantically irrelevant — canonical
+#: form sorts their first two operands so ``max a b`` ≡ ``max b a``
+_COMMUTATIVE_PRIMS = {
+    "add", "mul", "max", "min", "and", "or", "xor", "eq", "ne",
+}
+
+
+def _iter_eqns(jaxpr):
+    """Depth-first over every eqn, descending into sub-jaxprs (pjit,
+    closed_call, scan bodies, ...)."""
+    from jax.extend import core as jex_core  # jax >= 0.4.x
+
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            sub = getattr(val, "jaxpr", None)
+            if sub is not None:
+                yield from _iter_eqns(sub)
+            elif isinstance(val, jex_core.Jaxpr):
+                yield from _iter_eqns(val)
+            elif isinstance(val, (list, tuple)):
+                for v in val:
+                    s = getattr(v, "jaxpr", None)
+                    if s is not None:
+                        yield from _iter_eqns(s)
+
+
+def _canonical_lines(jaxpr) -> List[str]:
+    """Alpha-renamed, commutativity-canonicalized eqn listing."""
+    names = {}
+
+    def nm(v) -> str:
+        # Literal values print as-is; vars rename by first appearance
+        if not hasattr(v, "count") and not hasattr(v, "aval"):
+            return repr(v)
+        if type(v).__name__ == "Literal":
+            return repr(getattr(v, "val", v))
+        key = id(v)
+        if key not in names:
+            names[key] = f"v{len(names)}"
+        return names[key]
+
+    for v in jaxpr.invars:
+        nm(v)
+    lines: List[str] = []
+    for eqn in _iter_eqns(jaxpr):
+        prim = eqn.primitive.name
+        ins = [nm(v) for v in eqn.invars]
+        if prim in _COMMUTATIVE_PRIMS and len(ins) == 2:
+            ins = sorted(ins)
+        outs = [nm(v) for v in eqn.outvars]
+        lines.append(f"{','.join(outs)} = {prim} {' '.join(ins)}")
+    lines.append("ret " + " ".join(nm(v) for v in jaxpr.outvars))
+    return lines
+
+
+def _leaf_avals(tree):
+    import jax
+
+    return [(leaf.shape, str(leaf.dtype)) for leaf in jax.tree.leaves(tree)]
+
+
+def check_registered_joins(rel_base: pathlib.Path) -> List[Finding]:
+    import inspect
+
+    import jax
+
+    from crdt_tpu.ops import joins as joins_mod
+
+    findings: List[Finding] = []
+    registry = joins_mod.registered_joins()
+    for name, spec in sorted(registry.items()):
+        # findings anchor at the join's own definition site
+        try:
+            fn = inspect.unwrap(spec.join)
+            src_file = pathlib.Path(inspect.getsourcefile(fn) or "?")
+            line = inspect.getsourcelines(fn)[1]
+            relpath = src_file.resolve().relative_to(rel_base).as_posix()
+        except (TypeError, OSError, ValueError):
+            relpath, line = "crdt_tpu/ops/joins.py", 1
+
+        a, b = spec.example()
+        try:
+            closed = jax.make_jaxpr(spec.join)(a, b)
+        except Exception as e:
+            findings.append(Finding(
+                rule="CRDT101", path=relpath, line=line, scope=name,
+                detail=f"{name}|untraceable",
+                message=f"join '{name}' failed to trace abstractly: {e}",
+            ))
+            continue
+
+        # CRDT101: purity
+        for eqn in _iter_eqns(closed.jaxpr):
+            pname = eqn.primitive.name
+            if any(m in pname for m in _CALLBACK_MARKERS):
+                findings.append(Finding(
+                    rule="CRDT101", path=relpath, line=line, scope=name,
+                    detail=f"{name}|{pname}",
+                    message=(f"join '{name}' traces host-callback primitive "
+                             f"'{pname}': joins must be pure device "
+                             f"functions of their operands"),
+                ))
+
+        # CRDT102: aval closure — out avals == self-operand avals
+        in_avals = _leaf_avals(a)
+        out_avals = [(v.aval.shape, str(v.aval.dtype))
+                     for v in closed.jaxpr.outvars]
+        if in_avals != out_avals:
+            findings.append(Finding(
+                rule="CRDT102", path=relpath, line=line, scope=name,
+                detail=f"{name}|aval-closure",
+                message=(f"join '{name}' is not aval-closed: inputs "
+                         f"{in_avals} vs outputs {out_avals} — joins must "
+                         f"map S × S → S on one layout"),
+            ))
+
+        # CRDT103: operand-swap symmetry where claimed
+        if spec.structurally_commutative:
+            swapped = jax.make_jaxpr(
+                lambda x, y, _join=spec.join: _join(y, x))(a, b)
+            if _canonical_lines(closed.jaxpr) != _canonical_lines(swapped.jaxpr):
+                findings.append(Finding(
+                    rule="CRDT103", path=relpath, line=line, scope=name,
+                    detail=f"{name}|swap-asymmetry",
+                    message=(f"join '{name}' claims structural commutativity "
+                             f"but its jaxpr differs under operand swap — "
+                             f"drop the claim (and rely on the runtime law "
+                             f"tests) or fix the join"),
+                ))
+    return findings

@@ -135,8 +135,8 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     int(r): int(s)
                     for r, s in json.loads(q["vv"][0]).items()
                 }
-            except Exception:
-                return "bad"
+            except (ValueError, TypeError, AttributeError):
+                return "bad"  # unparseable JSON / non-dict / non-int fields
 
         def do_GET(self):
             url = urlparse(self.path)

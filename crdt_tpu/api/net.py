@@ -71,20 +71,27 @@ class RemotePeer:
         self.backoff_cap_s = backoff_cap_s
         self.failures = 0
         self.retry_at = 0.0  # time.monotonic() deadline; 0 = available
+        # backoff state is written from the fused-pull / barrier executor
+        # threads AND read by the agent loop — a torn failures/retry_at
+        # pair would mint a bogus backoff window (crdtlint CRDT201)
+        self._backoff_lock = threading.Lock()
 
     def _note_reachable(self) -> None:
-        self.failures = 0
-        self.retry_at = 0.0
+        with self._backoff_lock:
+            self.failures = 0
+            self.retry_at = 0.0
 
     def _note_transport_failure(self) -> None:
-        self.failures += 1
-        delay = min(self.backoff_cap_s,
-                    self.backoff_base_s * (2 ** (self.failures - 1)))
-        self.retry_at = time.monotonic() + delay
+        with self._backoff_lock:
+            self.failures += 1
+            delay = min(self.backoff_cap_s,
+                        self.backoff_base_s * (2 ** (self.failures - 1)))
+            self.retry_at = time.monotonic() + delay
 
     def backed_off(self) -> bool:
         """True while the transport-failure backoff window is open."""
-        return time.monotonic() < self.retry_at
+        with self._backoff_lock:
+            return time.monotonic() < self.retry_at
 
     def _get(self, path: str,
              headers: Optional[Dict[str, str]] = None) -> Optional[bytes]:

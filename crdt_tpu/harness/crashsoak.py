@@ -168,8 +168,8 @@ class Daemon:
                 code, _ = _http(self.url + "/ping", timeout=2)
                 if code == 200:
                     return
-            except Exception:
-                pass
+            except (urllib.error.URLError, OSError):
+                pass  # not up yet: transport failures only, keep polling
             if self.proc.poll() is not None:
                 raise RuntimeError(
                     f"daemon slot {self.slot} exited rc={self.proc.returncode}"

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import random
+import urllib.error
 import urllib.request
 from typing import List, Optional, Tuple
 
@@ -70,8 +71,8 @@ class WorkloadGenerator:
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as res:
                     accepted += res.status == 200
-            except Exception:
-                pass  # dead replica: skipped
+            except (urllib.error.URLError, OSError):
+                pass  # dead replica: skipped (transport failures only)
         return accepted
 
     # ---- sequence-lattice drive (demo: /seq/insert + /seq/remove) ----
@@ -98,8 +99,8 @@ class WorkloadGenerator:
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as res:
                     accepted += res.status == 200
-            except Exception:
-                pass  # dead replica: skipped
+            except (urllib.error.URLError, OSError):
+                pass  # dead replica: skipped (transport failures only)
         return accepted
 
     # ---- map-lattice drive (demo: /map/upd + /map/rem) ----
@@ -133,8 +134,8 @@ class WorkloadGenerator:
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as res:
                     accepted += res.status == 200
-            except Exception:
-                pass  # dead replica: skipped
+            except (urllib.error.URLError, OSError):
+                pass  # dead replica: skipped (transport failures only)
         return accepted
 
     # ---- HTTP drive (works against the Go reference too) ----
@@ -152,6 +153,6 @@ class WorkloadGenerator:
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as res:
                     accepted += res.status == 200
-            except Exception:
+            except (urllib.error.URLError, OSError):
                 pass  # dead replica: skipped, like main.go:301-304
         return accepted

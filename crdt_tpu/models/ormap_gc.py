@@ -177,7 +177,7 @@ def reset_barrier(
     r = jax.tree.leaves(sw.state)[0].shape[0]
     acc = jax.tree.map(lambda x: x[0], sw.state)
     for i in range(1, r):
-        acc = join(acc, jax.tree.map(lambda x: x[i], sw.state),
+        acc = join(acc, jax.tree.map(lambda x, _i=i: x[_i], sw.state),
                    value_join_batched)
     had_history = (acc.map.presence.tok > -1).any(axis=-1)
     removed = had_history & ~ormap.contains(acc.map)

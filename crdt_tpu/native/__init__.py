@@ -66,7 +66,9 @@ def _build() -> bool:
             check=True, capture_output=True,
         )
         return _SO.exists()
-    except Exception:
+    except (subprocess.SubprocessError, OSError):
+        # no toolchain or the build failed: the pure-Python fallback is
+        # the supported path, so this is a soft miss, not an error
         return False
 
 

@@ -12,7 +12,8 @@ The reference converges a swarm by many pairwise gossip merges
 """
 from __future__ import annotations
 
-from typing import Any, Callable
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -110,3 +111,112 @@ def converge(join_fn: Callable, state: Any, neutral: Any) -> Any:
     r = _leading_dim(state)
     top = tree_reduce_join(join_fn, state, neutral)
     return jax.tree.map(lambda t: jnp.broadcast_to(t[None], (r,) + t.shape), top)
+
+
+# ---- join registry ----------------------------------------------------------
+#
+# Every lattice join the package ships is registered here with enough
+# metadata to trace it abstractly: an example-operand factory (avals only —
+# the values never run) and its algebraic claims.  The registry is the
+# ground truth for the static ACI/purity gate (crdt_tpu.analysis
+# .jaxpr_checks): a join merged without a registration is a lint finding
+# waiting to happen, and a registered join is machine-checked on every CI
+# run for callback-freedom, aval closure (out avals == self-operand avals)
+# and — where claimed — operand-swap symmetry of its jaxpr.
+#
+# ``structurally_commutative`` claims the STRONG, statically checkable
+# property: the jaxpr of join(a, b) is identical to the jaxpr of
+# join(b, a) after canonicalizing commutative primitives (max, add, or,
+# ...).  Pointwise-max lattices satisfy it; select-based joins (lww,
+# mvregister) and sort-network unions (orset, rseq, oplog) are
+# extensionally commutative but not operand-symmetric instruction streams
+# — those rely on the runtime law tests (tests/test_lattice_laws.py).
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinSpec:
+    """One registered lattice join: the function, an example-operand
+    factory (returns the (a, b) pair to trace with), and its claims."""
+
+    name: str
+    join: Callable
+    example: Callable[[], Tuple[Any, Any]]
+    structurally_commutative: bool = False
+
+
+_JOIN_REGISTRY: Dict[str, JoinSpec] = {}
+_BUILTINS_REGISTERED = False
+
+
+def register_join(name: str, join_fn: Callable,
+                  example: Callable[[], Tuple[Any, Any]], *,
+                  structurally_commutative: bool = False) -> JoinSpec:
+    """Register a lattice join for the static ACI/purity gate.  ``example``
+    builds a concrete (a, b) operand pair; only its avals are used."""
+    spec = JoinSpec(name=name, join=join_fn, example=example,
+                    structurally_commutative=structurally_commutative)
+    _JOIN_REGISTRY[name] = spec
+    return spec
+
+
+def registered_joins() -> Dict[str, JoinSpec]:
+    """Name → JoinSpec for every join the package exports (builtin model
+    joins register on first access; imports are deferred to dodge the
+    ops ↔ models import cycle)."""
+    _register_builtin_joins()
+    return dict(_JOIN_REGISTRY)
+
+
+def _register_builtin_joins() -> None:
+    global _BUILTINS_REGISTERED
+    if _BUILTINS_REGISTERED:
+        return
+    _BUILTINS_REGISTERED = True
+
+    from crdt_tpu.models import (
+        compactlog,
+        flags,
+        gcounter,
+        gset,
+        lww,
+        mvregister,
+        oplog,
+        orset,
+        pncounter,
+        rseq,
+    )
+
+    register_join("gcounter", gcounter.join,
+                  lambda: (gcounter.zero(8), gcounter.zero(8)),
+                  structurally_commutative=True)
+    register_join("pncounter", pncounter.join,
+                  lambda: (pncounter.zero(8), pncounter.zero(8)),
+                  structurally_commutative=True)
+    register_join("lww", lww.join,
+                  lambda: (lww.zero(), lww.zero()))
+    register_join("lww_packed", lww.join_packed,
+                  lambda: (lww.pack(lww.zero()), lww.pack(lww.zero())))
+    register_join("mvregister", mvregister.join,
+                  lambda: (mvregister.zero(4), mvregister.zero(4)))
+    register_join("token_plane", flags.plane_join,
+                  lambda: (flags.plane_zero(4), flags.plane_zero(4)),
+                  structurally_commutative=True)
+    register_join("ew_flag", flags.ew_join,
+                  lambda: (flags.ew_zero(4), flags.ew_zero(4)),
+                  structurally_commutative=True)
+    register_join("dw_flag", flags.dw_join,
+                  lambda: (flags.dw_zero(4), flags.dw_zero(4)),
+                  structurally_commutative=True)
+    register_join("gset", gset.g_join,
+                  lambda: (gset.g_empty(16), gset.g_empty(16)))
+    register_join("twopset", gset.tp_join,
+                  lambda: (gset.tp_empty(16), gset.tp_empty(16)))
+    register_join("orset", orset.join,
+                  lambda: (orset.empty(16), orset.empty(16)))
+    register_join("rseq", rseq.join,
+                  lambda: (rseq.empty(16), rseq.empty(16)))
+    register_join("oplog", oplog.merge,
+                  lambda: (oplog.empty(32), oplog.empty(32)))
+    register_join("compactlog", compactlog.merge,
+                  lambda: (compactlog.empty(32, 8, 4),
+                           compactlog.empty(32, 8, 4)))

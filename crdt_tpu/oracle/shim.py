@@ -226,6 +226,7 @@ class OracleHttpCluster:
 
     def gossip_once(self, idx: int, peer: int) -> bool:
         """node idx pulls peer's full log over HTTP and merges."""
+        import urllib.error
         import urllib.request
 
         try:
@@ -236,5 +237,8 @@ class OracleHttpCluster:
                     return False
                 self.nodes[idx].receive_wire(res.read().decode())
                 return True
-        except Exception:
-            return False  # dead peer skipped (main.go:235-239)
+        except (urllib.error.URLError, OSError):
+            # dead peer skipped (main.go:235-239); a MALFORMED payload from
+            # a live peer still raises out of receive_wire — the oracle must
+            # be loud where the reference was silently lossy (quirk §0.1.8)
+            return False

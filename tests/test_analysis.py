@@ -1,0 +1,286 @@
+"""crdtlint self-tests: every rule fires on a minimal fixture exactly
+once, guards suppress where documented, and the committed baseline is
+clean against the current tree (the same invariant the CI gate enforces).
+"""
+import textwrap
+
+import pytest
+
+from crdt_tpu import analysis
+from crdt_tpu.analysis import ast_checks, baseline, concurrency
+from crdt_tpu.analysis import Finding
+
+
+def _lint_snippet(tmp_path, source, relpath="fixture.py"):
+    """Write ``source`` under tmp_path at ``relpath`` and AST-lint it
+    (relpath controls the hot-package gating of CRDT003)."""
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return ast_checks.check_file(p, tmp_path)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------- CRDT001
+
+def test_donation_after_use_fires_once(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        from crdt_tpu.ops import joins
+
+        def round(a, b):
+            merge = joins.donating(join)
+            out = merge(a, b)
+            return out + a
+    """)
+    assert _rules(findings) == ["CRDT001"]
+    (f,) = findings
+    assert "`a` was donated" in f.message
+    assert f.severity == "error"
+
+
+def test_donation_rebinding_resets(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        from crdt_tpu.ops import joins
+
+        def round(a, b):
+            merge = joins.donating(join)
+            a = merge(a, b)
+            return a
+    """)
+    assert findings == []
+
+
+def test_jit_donate_argnums_tracked(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax
+
+        def round(a, b):
+            f = jax.jit(step, donate_argnums=(1,))
+            out = f(a, b)
+            return out + b
+    """)
+    assert _rules(findings) == ["CRDT001"]
+
+
+# ---------------------------------------------------------------- CRDT002
+
+def test_jit_in_loop_fires_once(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax
+
+        def rounds(xs):
+            outs = []
+            for x in xs:
+                f = jax.jit(step)
+                outs.append(f(x))
+            return outs
+    """)
+    assert _rules(findings) == ["CRDT002"]
+
+
+def test_jit_hoisted_is_clean(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        import jax
+
+        def rounds(xs):
+            f = jax.jit(step)
+            return [f(x) for x in xs]
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------- CRDT003
+
+def test_host_sync_fires_in_hot_package(tmp_path):
+    src = """
+        import numpy as np
+
+        def peek(x):
+            return np.asarray(x)
+    """
+    hot = _lint_snippet(tmp_path, src, relpath="crdt_tpu/ops/fixture.py")
+    assert _rules(hot) == ["CRDT003"]
+    cold = _lint_snippet(tmp_path, src, relpath="crdt_tpu/harness/fixture.py")
+    assert cold == []
+
+
+# ---------------------------------------------------------------- CRDT004
+
+def test_silent_except_fires_once(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        def poll(url):
+            try:
+                fetch(url)
+            except Exception:
+                pass
+    """)
+    assert _rules(findings) == ["CRDT004"]
+    assert findings[0].severity == "error"
+
+
+def test_handled_except_is_clean(tmp_path):
+    findings = _lint_snippet(tmp_path, """
+        def poll(url, events):
+            try:
+                fetch(url)
+            except Exception as e:
+                events.emit("poll_failed", error=str(e))
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------- CRDT201
+
+def test_unlocked_thread_mutation_fires_once(tmp_path):
+    p = tmp_path / "agent.py"
+    p.write_text(textwrap.dedent("""
+        import threading
+
+        class Agent:
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                self.errors.append("boom")
+    """))
+    findings = concurrency.check_files([p], tmp_path)
+    assert _rules(findings) == ["CRDT201"]
+    assert "self.errors.append()" in findings[0].message
+
+
+def test_locked_thread_mutation_is_clean(tmp_path):
+    p = tmp_path / "agent.py"
+    p.write_text(textwrap.dedent("""
+        import threading
+
+        class Agent:
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    self.errors.append("boom")
+    """))
+    assert concurrency.check_files([p], tmp_path) == []
+
+
+# ------------------------------------------------------------- jaxpr layer
+
+def _bad_registry():
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops.joins import JoinSpec
+
+    def example():
+        return jnp.zeros((4,), jnp.int32), jnp.zeros((4,), jnp.int32)
+
+    def impure(a, b):
+        out = jax.pure_callback(
+            lambda x: x, jax.ShapeDtypeStruct(a.shape, a.dtype),
+            jnp.maximum(a, b))
+        return out
+
+    def not_closed(a, b):
+        return jnp.concatenate([a, b])
+
+    def asymmetric(a, b):
+        return a  # trivially non-symmetric under operand swap
+
+    return {
+        "impure": JoinSpec("impure", impure, example),
+        "not_closed": JoinSpec("not_closed", not_closed, example),
+        "asymmetric": JoinSpec("asymmetric", asymmetric, example,
+                               structurally_commutative=True),
+    }
+
+
+def test_jaxpr_checks_catch_planted_defects(monkeypatch):
+    from crdt_tpu.analysis import jaxpr_checks
+    from crdt_tpu.ops import joins as joins_mod
+
+    monkeypatch.setattr(joins_mod, "registered_joins", _bad_registry)
+    findings = jaxpr_checks.check_registered_joins(analysis.repo_root())
+    by_scope = {f.scope: f.rule for f in findings}
+    assert by_scope == {
+        "impure": "CRDT101",
+        "not_closed": "CRDT102",
+        "asymmetric": "CRDT103",
+    }
+
+
+def test_real_registry_is_clean_and_complete():
+    """The acceptance invariant: every join the package exports traces
+    callback-free, aval-closed, and swap-symmetric where claimed."""
+    from crdt_tpu.analysis import jaxpr_checks
+    from crdt_tpu.ops import joins as joins_mod
+
+    registry = joins_mod.registered_joins()
+    expected = {
+        "gcounter", "pncounter", "lww", "lww_packed", "mvregister",
+        "token_plane", "ew_flag", "dw_flag", "gset", "twopset",
+        "orset", "rseq", "oplog", "compactlog",
+    }
+    assert expected <= set(registry)
+    assert jaxpr_checks.check_registered_joins(analysis.repo_root()) == []
+
+
+# --------------------------------------------------------------- baseline
+
+def test_fingerprint_survives_line_drift():
+    a = Finding(rule="CRDT003", path="crdt_tpu/ops/x.py", line=10,
+                message="m", scope="f", detail="np.asarray(x)")
+    b = Finding(rule="CRDT003", path="crdt_tpu/ops/x.py", line=99,
+                message="m", scope="f", detail="np.asarray(x)")
+    assert baseline.fingerprint(a) == baseline.fingerprint(b)
+
+
+def test_baseline_diff_flags_new_findings(tmp_path):
+    known = Finding(rule="CRDT003", path="a.py", line=1, message="m",
+                    scope="f", detail="d")
+    bl = tmp_path / "baseline.json"
+    baseline.save([known], bl)
+    fresh = Finding(rule="CRDT004", path="b.py", line=2, message="m2",
+                    scope="g", detail="e")
+    new, stale = baseline.diff([known, fresh], bl)
+    assert [f.rule for f in new] == ["CRDT004"]
+    assert stale == []
+    new2, stale2 = baseline.diff([fresh], bl)
+    assert [f.rule for f in new2] == ["CRDT004"]
+    assert [e["rule"] for e in stale2] == ["CRDT003"]
+
+
+def test_tree_is_clean_against_committed_baseline():
+    """What CI's `--check-baseline` enforces: zero new findings on the
+    current tree vs crdt_tpu/analysis/baseline.json."""
+    findings = analysis.run_all()
+    new, _stale = baseline.diff(findings)
+    assert new == [], "\n".join(f.render() for f in new)
+    # and nothing in the tree is error-severity (errors are fixed, not
+    # baselined — the baseline holds triaged warns only)
+    assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_cli_check_baseline_exit_codes(tmp_path, monkeypatch):
+    from crdt_tpu.analysis import __main__ as cli
+
+    # a defect-free fixture tree: exit 0 even with an empty baseline
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f(x):\n    return x + 1\n")
+    empty_bl = tmp_path / "bl.json"
+    assert cli.main([str(clean), "--no-jaxpr", "--check-baseline",
+                     "--baseline", str(empty_bl)]) == 0
+
+    # inject a fixture defect: the gate must go red
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def poll(u):\n"
+        "    try:\n"
+        "        fetch(u)\n"
+        "    except Exception:\n"
+        "        pass\n")
+    assert cli.main([str(bad), "--no-jaxpr", "--check-baseline",
+                     "--baseline", str(empty_bl)]) == 1

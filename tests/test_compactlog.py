@@ -220,7 +220,7 @@ def test_swarm_compaction_round_bounds_tails():
     fr = np.asarray(s2.state.frontier)
     assert (fr == fr[0]).all()
     for i, want in enumerate(want_each):
-        got = compactlog.rebuild(jax.tree.map(lambda x: x[i], s2.state))
+        got = compactlog.rebuild(jax.tree.map(lambda x, _i=i: x[_i], s2.state))
         assert tree_equal(got, want)
     # tails shrank by exactly the folded stable prefix
     before = np.asarray(jax.vmap(compactlog.size)(s.state))
@@ -258,7 +258,7 @@ def test_swarm_gossip_then_compact_then_converge():
     s = swarm.converge(s, join_b, neutral)
     s = swarm.compaction_round(s, compactlog.received_vv, compactlog.compact, lambda c: c.frontier)
     for i in range(len(logs)):
-        got = compactlog.rebuild(jax.tree.map(lambda x: x[i], s.state))
+        got = compactlog.rebuild(jax.tree.map(lambda x, _i=i: x[_i], s.state))
         assert tree_equal(got, want)
     # everything stable got folded: tails are empty
     assert (np.asarray(jax.vmap(compactlog.size)(s.state)) == 0).all()
@@ -286,7 +286,7 @@ def test_dead_replica_misses_barrier_then_catches_up():
     s = swarm.set_alive(s, dead, True)
     s = swarm.converge(s, join_b, neutral)               # revival catch-up
     for i in range(len(logs)):
-        got = compactlog.rebuild(jax.tree.map(lambda x: x[i], s.state))
+        got = compactlog.rebuild(jax.tree.map(lambda x, _i=i: x[_i], s.state))
         assert tree_equal(got, want)
 
 
@@ -329,5 +329,5 @@ def test_barrier_skipped_when_frontier_holders_dead():
     fr = np.asarray(s2.state.frontier)
     assert (fr == [f - 1 for f in full]).all()
     for i in range(3):
-        got = compactlog.rebuild(jax.tree.map(lambda x: x[i], s2.state))
+        got = compactlog.rebuild(jax.tree.map(lambda x, _i=i: x[_i], s2.state))
         assert tree_equal(got, want)

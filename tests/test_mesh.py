@@ -96,7 +96,7 @@ def test_allreduce_join_both_paths(n_dev):
     for l in logs[1:]:
         expect = oplog.merge(expect, l)
     for i in range(n_dev):
-        assert tree_equal(jax.tree.map(lambda x: x[i], jax.device_get(got)), jax.device_get(expect))
+        assert tree_equal(jax.tree.map(lambda x, _i=i: x[_i], jax.device_get(got)), jax.device_get(expect))
 
 
 def test_pjit_auto_sharding_gossip_round(mesh8):

@@ -103,13 +103,13 @@ def test_swarm_converge():
     for r in range(R):
         m = _empty()
         if r == 2:
-            m = ormap.update(m, 0, r, lambda v: pncounter.add(v, r, r + 1))
+            m = ormap.update(m, 0, r, lambda v, _r=r: pncounter.add(v, _r, _r + 1))
         rows.append(m)
     state = jax.tree.map(lambda *xs: jax.numpy.stack(xs), *rows)
     s = swarm.make(state)
     s = swarm.converge(s, jax.vmap(pn_join), _empty())
     for i in range(R):
-        row = jax.tree.map(lambda x: x[i], s.state)
+        row = jax.tree.map(lambda x, _i=i: x[_i], s.state)
         assert bool(ormap.contains(row)[0])
         assert int(pncounter.value(ormap.get(row, 0))) == 3
 

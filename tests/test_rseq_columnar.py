@@ -121,13 +121,13 @@ def test_converge_matches_generic(seed=7):
     col = rc.stack(state)
     conv, max_nu = rc.converge_checked(col, interpret=True)
     # generic ground truth: fold all replicas pairwise
-    states = [jax.tree.map(lambda x: x[i], state) for i in range(4)]
+    states = [jax.tree.map(lambda x, _i=i: x[_i], state) for i in range(4)]
     top = states[0]
     for s in states[1:]:
         top = rseq.join(top, s)
     got = rc.unstack(conv)
     for i in range(4):
-        one = jax.tree.map(lambda x: x[i], got)
+        one = jax.tree.map(lambda x, _i=i: x[_i], got)
         assert rseq.to_list(one) == rseq.to_list(top)
     assert int(max_nu) <= CAP
 
@@ -144,12 +144,12 @@ def test_converge_respects_alive_mask():
     orig = jax.tree.map(lambda x: x[2], state)
     assert rseq.to_list(dead) == rseq.to_list(orig)
     # alive lanes agree with the alive-only LUB (dead contributes nothing)
-    states = [jax.tree.map(lambda x: x[i], state) for i in (0, 1, 3)]
+    states = [jax.tree.map(lambda x, _i=i: x[_i], state) for i in (0, 1, 3)]
     top = states[0]
     for s in states[1:]:
         top = rseq.join(top, s)
     for i in (0, 1, 3):
-        one = jax.tree.map(lambda x: x[i], got)
+        one = jax.tree.map(lambda x, _i=i: x[_i], got)
         assert rseq.to_list(one) == rseq.to_list(top)
 
 
@@ -160,10 +160,10 @@ def test_gossip_round_matches_generic():
     peers = jnp.asarray([1, 2, 3, 0], jnp.int32)
     got = rc.unstack(rc.gossip_round(col, peers, interpret=True))
     for i, p in enumerate([1, 2, 3, 0]):
-        a = jax.tree.map(lambda x: x[i], state)
-        b = jax.tree.map(lambda x: x[p], state)
+        a = jax.tree.map(lambda x, _i=i: x[_i], state)
+        b = jax.tree.map(lambda x, _p=p: x[_p], state)
         want = rseq.join(a, b)
-        one = jax.tree.map(lambda x: x[i], got)
+        one = jax.tree.map(lambda x, _i=i: x[_i], got)
         assert rseq.to_list(one) == rseq.to_list(want)
 
 
