@@ -245,6 +245,7 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 body = health.render_node_metrics(
                     self.node, set_node=self.set_node,
                     seq_node=self.seq_node, map_node=self.map_node,
+                    agent=getattr(admin, "agent", None),
                 )
                 self._send(200, body, PROM_CTYPE)
             elif url.path == "/ping":

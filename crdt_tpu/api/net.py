@@ -45,11 +45,21 @@ from crdt_tpu.utils.config import ClusterConfig
 from crdt_tpu.utils.metrics import Metrics
 
 
+# RemotePeer circuit-breaker states (exposed as the
+# net_peer_circuit_state gauge: 0 / 1 / 2 in this order)
+CIRCUIT_CLOSED = "closed"
+CIRCUIT_HALF_OPEN = "half_open"
+CIRCUIT_OPEN = "open"
+
+
 class RemotePeer:
     """Client for one peer's reference-surface HTTP endpoint."""
 
     def __init__(self, url: str, timeout: float = 5.0,
-                 backoff_base_s: float = 0.5, backoff_cap_s: float = 30.0):
+                 backoff_base_s: float = 0.5, backoff_cap_s: float = 30.0,
+                 failure_threshold: int = 1,
+                 rng: Optional[random.Random] = None,
+                 clock=None):
         self.url = url.rstrip("/")
         self.timeout = timeout
         # None = unknown, False = peer 404'd /set/gossip (an original
@@ -59,19 +69,33 @@ class RemotePeer:
         self.serves_set: Optional[bool] = None
         self.serves_seq: Optional[bool] = None  # same, for /seq/gossip
         self.serves_map: Optional[bool] = None  # same, for /map/gossip
-        # per-peer transport backoff: consecutive TRANSPORT failures
-        # (connection refused / socket timeout — the peer's process or
-        # network is gone) push retry_at out exponentially so one
-        # unreachable peer cannot stall every round at full timeout.  A
-        # reachable peer that answers with ANY HTTP status — including the
-        # dead-node 502 — resets the clock: it costs the round ~nothing
+        # per-peer circuit breaker over TRANSPORT failures (connection
+        # refused / socket timeout — the peer's process or network is
+        # gone): after ``failure_threshold`` consecutive failures the
+        # breaker OPENS and the peer is skipped — so one unreachable peer
+        # cannot stall every round at full timeout.  The skip window uses
+        # DECORRELATED JITTER, min(cap, U(base, 3*prev)): the previous
+        # deterministic 2^n schedule made every agent in a fleet re-probe
+        # a revived peer in lockstep.  An expired window admits exactly
+        # one HALF-OPEN probe: success closes the breaker, failure
+        # re-opens it with a fresh jittered window.  A reachable peer
+        # that answers with ANY HTTP status — including the dead-node
+        # 502 — closes the breaker instantly: it costs the round ~nothing
         # and may revive at any moment (tests/test_net.py pins that a
         # revived node is pulled on the very next round).
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
+        self.failure_threshold = max(1, failure_threshold)
         self.failures = 0
         self.retry_at = 0.0  # time.monotonic() deadline; 0 = available
-        # backoff state is written from the fused-pull / barrier executor
+        # injectable randomness/clock: agents seed the rng per (seed, url)
+        # so pinned soaks replay their jitter; tests pin the half-open
+        # transition with a manual clock
+        self._rng = rng if rng is not None else random.Random()
+        self._now = clock if clock is not None else time.monotonic
+        self._delay = 0.0  # previous jittered window (decorrelation state)
+        self._state = CIRCUIT_CLOSED
+        # breaker state is written from the fused-pull / barrier executor
         # threads AND read by the agent loop — a torn failures/retry_at
         # pair would mint a bogus backoff window (crdtlint CRDT201)
         self._backoff_lock = threading.Lock()
@@ -80,18 +104,42 @@ class RemotePeer:
         with self._backoff_lock:
             self.failures = 0
             self.retry_at = 0.0
+            self._delay = 0.0
+            self._state = CIRCUIT_CLOSED
 
     def _note_transport_failure(self) -> None:
         with self._backoff_lock:
             self.failures += 1
-            delay = min(self.backoff_cap_s,
-                        self.backoff_base_s * (2 ** (self.failures - 1)))
-            self.retry_at = time.monotonic() + delay
+            if (self._state == CIRCUIT_HALF_OPEN
+                    or self.failures >= self.failure_threshold):
+                prev = self._delay if self._delay > 0 else self.backoff_base_s
+                self._delay = min(
+                    self.backoff_cap_s,
+                    self._rng.uniform(self.backoff_base_s, prev * 3.0),
+                )
+                self.retry_at = self._now() + self._delay
+                self._state = CIRCUIT_OPEN
 
     def backed_off(self) -> bool:
-        """True while the transport-failure backoff window is open."""
+        """True while the breaker forbids traffic this round.  An OPEN
+        breaker past its jittered deadline transitions to HALF-OPEN here
+        and admits the observing caller as its single probe; every other
+        caller keeps getting True until the probe resolves through
+        _note_reachable (close) or _note_transport_failure (re-open)."""
         with self._backoff_lock:
-            return time.monotonic() < self.retry_at
+            if self._state == CIRCUIT_CLOSED:
+                return False
+            if self._state == CIRCUIT_OPEN:
+                if self._now() < self.retry_at:
+                    return True
+                self._state = CIRCUIT_HALF_OPEN
+                return False  # this caller IS the half-open probe
+            return True  # HALF_OPEN: a probe is already in flight
+
+    def circuit_state(self) -> str:
+        """The breaker's current state name (obs gauge + tests)."""
+        with self._backoff_lock:
+            return self._state
 
     def _get(self, path: str,
              headers: Optional[Dict[str, str]] = None) -> Optional[bytes]:
@@ -385,6 +433,10 @@ class NetworkAgent:
                 timeout=self.config.peer_timeout_s,
                 backoff_base_s=self.config.peer_backoff_base_s,
                 backoff_cap_s=self.config.peer_backoff_cap_s,
+                failure_threshold=self.config.peer_failure_threshold,
+                # per-(seed, url) jitter rng: decorrelated across the
+                # fleet's agents, replayable under a pinned seed
+                rng=random.Random(f"{self.config.seed}:{u}"),
             )
             for u in peer_urls
         ]
@@ -418,8 +470,22 @@ class NetworkAgent:
         if min(self.config.fuse_pull_k, len(avail)) > 1:
             return self._gossip_once_fused(avail)
         peer = self._rng.choice(avail)
+        merged = self.pull_from(peer)
+        self.set_pull(peer)
+        self.seq_pull(peer)
+        self.map_pull(peer)
+        return merged
+
+    def pull_from(self, peer: RemotePeer) -> bool:
+        """One KV pull round from a SPECIFIC peer client (the nemesis soak
+        drives exact edges through this).  Malformed payloads are
+        QUARANTINED (event + metric, round skipped) instead of killing the
+        gossip loop — one corrupt peer must degrade, not destroy, this
+        node's anti-entropy (the reference's loop died silently forever on
+        one bad payload, quirk §0.1.8; ours died loudly — still a total
+        outage of the pull loop)."""
         tid = mint_trace_id(self.node.rid)
-        merged = pull_round(
+        return pull_round(
             self.node,
             lambda since: peer.gossip_payload(since, trace=tid),
             self.metrics,
@@ -427,11 +493,8 @@ class NetworkAgent:
             prefix="net_gossip",
             peer=peer.url,
             trace=tid,
+            quarantine=True,
         )
-        self.set_pull(peer)
-        self.seq_pull(peer)
-        self.map_pull(peer)
-        return merged
 
     def _available_peers(self) -> List[RemotePeer]:
         """Peers not inside a transport-failure backoff window.  Skips are
@@ -445,7 +508,8 @@ class NetworkAgent:
             if p.backed_off():
                 self.metrics.inc("net_peer_backoff_skips")
                 self.node.events.emit("peer_backoff_skip", peer=p.url,
-                                      failures=p.failures)
+                                      failures=p.failures,
+                                      circuit=p.circuit_state())
             else:
                 avail.append(p)
         return avail
@@ -476,6 +540,7 @@ class NetworkAgent:
             delta=self.config.delta_gossip,
             prefix="net_gossip",
             trace=tid,
+            quarantine=True,
         )
         for peer, body in zip(peers, payloads):
             if body is None:
@@ -501,9 +566,26 @@ class NetworkAgent:
                 else "set_gossip_skipped"
             )
             return False
-        fresh = sn.receive(payload)
+        fresh = self._receive_quarantined(sn, payload, "set_gossip", peer)
         self.metrics.inc("set_gossip_rounds" if fresh else "set_gossip_noop")
         return fresh > 0
+
+    def _receive_quarantined(self, lattice, payload, prefix: str,
+                             peer: RemotePeer) -> int:
+        """Merge one sibling-lattice payload, quarantining malformed
+        bodies: the reference's gossip loop died forever on one bad
+        payload (quirk §0.1.8) — here the round is skipped loudly
+        (``{prefix}_quarantined`` + a ``payload_quarantine`` event) and
+        the loop lives on."""
+        try:
+            return lattice.receive(payload)
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            self.metrics.inc(f"{prefix}_quarantined")
+            self.node.events.emit(
+                "payload_quarantine", surface=prefix, peer=peer.url,
+                error=f"{type(e).__name__}: {e}"[:200],
+            )
+            return 0
 
     def seq_pull(self, peer: RemotePeer) -> bool:
         """One sequence-lattice pull from ``peer`` (no-op without a seq
@@ -519,7 +601,7 @@ class NetworkAgent:
                 else "seq_gossip_skipped"
             )
             return False
-        fresh = qn.receive(payload)
+        fresh = self._receive_quarantined(qn, payload, "seq_gossip", peer)
         self.metrics.inc("seq_gossip_rounds" if fresh else "seq_gossip_noop")
         return fresh > 0
 
@@ -612,7 +694,7 @@ class NetworkAgent:
                 else "map_gossip_skipped"
             )
             return False
-        fresh = mn.receive(payload)
+        fresh = self._receive_quarantined(mn, payload, "map_gossip", peer)
         self.metrics.inc("map_gossip_rounds" if fresh else "map_gossip_noop")
         return fresh > 0
 
@@ -854,17 +936,7 @@ class NodeHost:
         configured peer) — deterministic external gossip drive."""
         if peer_url is None:
             return self.agent.gossip_once()
-        peer = RemotePeer(peer_url)
-        tid = mint_trace_id(self.node.rid)
-        return pull_round(
-            self.node,
-            lambda since: peer.gossip_payload(since, trace=tid),
-            self.agent.metrics,
-            delta=self.config.delta_gossip,
-            prefix="net_gossip",
-            peer=peer.url,
-            trace=tid,
-        )
+        return self.agent.pull_from(RemotePeer(peer_url))
 
     def admin_barrier(self) -> dict:
         """One compaction barrier, now (this host must be the fleet's

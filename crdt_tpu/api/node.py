@@ -98,7 +98,7 @@ def stable_frontier_host(vvs, frontiers) -> Dict[int, int]:
 
 def pull_round(node: "ReplicaNode", fetch_payload, metrics, delta: bool,
                prefix: str = "gossip", peer: Optional[str] = None,
-               trace: Optional[str] = None) -> bool:
+               trace: Optional[str] = None, quarantine: bool = False) -> bool:
     """One anti-entropy pull into ``node`` — the shared round body of every
     gossip driver (in-process LocalCluster, cross-process NetworkAgent): ask
     the peer for a (delta) payload, merge it, and keep the skip/noop/fresh
@@ -112,6 +112,14 @@ def pull_round(node: "ReplicaNode", fetch_payload, metrics, delta: bool,
     delta-payload op count is recorded as the lag-behind-``peer`` gauge
     (crdt_tpu.obs.health) — in delta mode that count IS how many ops this
     node lacked.
+
+    ``quarantine=True`` (the network drivers) turns a MALFORMED payload —
+    bad wire keys, out-of-window timestamps, truncated summary sections,
+    wrong-shaped commands — into a skipped round with a
+    ``payload_quarantine`` event and a ``{prefix}_quarantined`` count,
+    instead of an exception that kills the caller's gossip loop.  The
+    in-process LocalCluster keeps the loud-raise default: there a
+    malformed payload is a local bug, not a hostile network.
     """
     lab = str(node.rid)
     if not node.alive:
@@ -136,7 +144,16 @@ def pull_round(node: "ReplicaNode", fetch_payload, metrics, delta: bool,
             node.events.emit("pull_noop", trace=tid, peer=peer)
             return False
         metrics.inc(f"{prefix}_payload_ops", n_ops)
-        fresh = node.receive(payload)
+        try:
+            fresh = node.receive(payload)
+        except (ValueError, KeyError, TypeError) as e:
+            if not quarantine:
+                raise
+            metrics.inc(f"{prefix}_quarantined")
+            node.events.emit("payload_quarantine", trace=tid, peer=peer,
+                             surface=prefix,
+                             error=f"{type(e).__name__}: {e}"[:200])
+            return False
         if not fresh:  # payload was all re-deliveries (e.g. foreign ops)
             metrics.inc(f"{prefix}_noop")
             node.events.emit("pull_noop", trace=tid, peer=peer, ops=n_ops)
@@ -150,7 +167,8 @@ def pull_round(node: "ReplicaNode", fetch_payload, metrics, delta: bool,
 
 def fused_pull_round(node: "ReplicaNode", fetched, metrics, delta: bool,
                      prefix: str = "gossip",
-                     trace: Optional[str] = None) -> bool:
+                     trace: Optional[str] = None,
+                     quarantine: bool = False) -> bool:
     """The k-way sibling of :func:`pull_round` — the pipelined merge
     runtime's round body.  ``fetched`` is a list of ``(peer_label,
     payload_or_None)`` pairs the driver already collected (concurrently in
@@ -188,6 +206,16 @@ def fused_pull_round(node: "ReplicaNode", fetched, metrics, delta: bool,
                 metrics.inc(f"{prefix}_noop")
                 node.events.emit("pull_noop", trace=tid, peer=peer)
                 continue
+            if quarantine:
+                # pre-validate so ONE malformed payload quarantines alone
+                # instead of poisoning the whole fused dispatch
+                bad = node.validate_payload(payload)
+                if bad is not None:
+                    metrics.inc(f"{prefix}_quarantined")
+                    node.events.emit("payload_quarantine", trace=tid,
+                                     peer=peer, surface=prefix,
+                                     error=bad[:200])
+                    continue
             payloads.append(payload)
             labels.append(peer)
             total_ops += n_ops
@@ -195,7 +223,16 @@ def fused_pull_round(node: "ReplicaNode", fetched, metrics, delta: bool,
             return False
         health.observe_fused_pull(metrics.registry, lab, len(payloads))
         metrics.inc(f"{prefix}_payload_ops", total_ops)
-        fresh = node.receive_many(payloads)
+        try:
+            fresh = node.receive_many(payloads)
+        except (ValueError, KeyError, TypeError) as e:
+            if not quarantine:
+                raise
+            metrics.inc(f"{prefix}_quarantined")
+            node.events.emit("payload_quarantine", trace=tid, peers=labels,
+                             surface=prefix,
+                             error=f"{type(e).__name__}: {e}"[:200])
+            return False
         if not fresh:  # every payload was re-deliveries
             metrics.inc(f"{prefix}_noop")
             node.events.emit("pull_noop", trace=tid, peers=labels,
@@ -493,6 +530,25 @@ class ReplicaNode:
                 )
             rows.append((ts, rid, seq, cmd))
         return remote_frontier, remote_summary, rows
+
+    def validate_payload(self, payload: Dict[str, Any]) -> Optional[str]:
+        """Structural pre-check of a wire payload WITHOUT merging: returns
+        None when ``receive`` would accept it, else a short reason string.
+        The fused pull path uses this to quarantine ONE malformed payload
+        (byte-corrupted body that still parsed as JSON, mangled wire key,
+        out-of-window timestamp, non-dict command) without poisoning the
+        other k-1 payloads sharing its merge dispatch."""
+        try:
+            _, summary, rows = self._decode_payload(dict(payload))
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
+            return f"{type(e).__name__}: {e}"
+        for _, _, _, cmd in rows:
+            if not isinstance(cmd, dict):
+                return f"non-dict command: {type(cmd).__name__}"
+        for k, entry in summary.items():
+            if not isinstance(entry, dict):
+                return f"non-dict summary entry for key {k!r}"
+        return None
 
     def receive(self, payload: Optional[Dict[str, Any]]) -> int:
         """Pull-side merge of a peer's gossip payload (main.go:250-257);

@@ -1,0 +1,38 @@
+"""Deterministic fault-injection plane (the nemesis).
+
+Seeded, replayable fault schedules applied at the runtime's two I/O
+boundaries — the wire (``FaultyTransport`` around api.net.RemotePeer) and
+the disk (``FaultyDisk`` around utils.checkpoint) — plus the planted-
+corruption helpers the recovery tests use.  See crdt_tpu/faults/README.md
+for per-fault semantics and harness/nemesis_soak.py for the jepsen-lite
+runner that composes them.
+"""
+from crdt_tpu.faults.disk import (
+    FaultyDisk,
+    fsync_stall,
+    plant_corruption,
+    point_latest_at_missing,
+    tear_snapshot,
+)
+from crdt_tpu.faults.schedule import (
+    KINDS,
+    FaultPlane,
+    FaultRule,
+    NemesisSchedule,
+    SkewEvent,
+)
+from crdt_tpu.faults.transport import FaultyTransport
+
+__all__ = [
+    "KINDS",
+    "FaultPlane",
+    "FaultRule",
+    "FaultyDisk",
+    "FaultyTransport",
+    "NemesisSchedule",
+    "SkewEvent",
+    "fsync_stall",
+    "plant_corruption",
+    "point_latest_at_missing",
+    "tear_snapshot",
+]

@@ -1071,6 +1071,34 @@ class CrashSoakRunner:
                 f"across {d.boots} boots (event log lost writes?)"
             )
             r.event_boots += boots
+            # recovery provenance (crdt_tpu.utils.checkpoint): every
+            # restored boot must be backed by exactly one snapshot_restore
+            # event, and on this soak's UNDAMAGED disks the restore must
+            # have come from the manifest-verified LATEST target — any
+            # quarantine or generation fallback here means the checkpoint
+            # layer corrupted its own snapshots
+            restored_boots = sum(
+                1 for e in recs
+                if e.get("event") == "boot" and e.get("restored")
+            )
+            restores = [e for e in recs
+                        if e.get("event") == "snapshot_restore"]
+            assert len(restores) == restored_boots, (
+                f"black box: slot {d.slot} logged {len(restores)} "
+                f"snapshot_restore events for {restored_boots} restored "
+                "boots (recovery provenance lost)"
+            )
+            assert all(e.get("verified") and not e.get("fallback")
+                       for e in restores), (
+                f"black box: slot {d.slot} restored from an unverified or "
+                f"fallback snapshot on an undamaged disk: {restores}"
+            )
+            quarantines = [e for e in recs if e.get("event") in
+                           ("snapshot_quarantine", "payload_quarantine")]
+            assert not quarantines, (
+                f"black box: slot {d.slot} quarantined state during a "
+                f"fault-free-disk soak: {quarantines}"
+            )
         return r
 
     def close(self) -> None:

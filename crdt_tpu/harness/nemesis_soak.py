@@ -1,0 +1,506 @@
+"""Nemesis soak — the jepsen-lite composition harness of the fault plane.
+
+Drives an IN-PROCESS NodeHost fleet (HTTP servers up, gossip loops off —
+every round is driven explicitly, single-threaded) through a seeded
+:class:`crdt_tpu.faults.NemesisSchedule`: asymmetric partitions, dropped
+/ delayed / duplicated / reordered / truncated / corrupted deliveries,
+crashes + incarnation-bumped reboots, torn snapshot writes, fsync stalls,
+and clock skew — then heals and asserts the CRDT laws held:
+
+* **convergence-after-heal** — every node reaches the SAME materialized
+  state and version vector within a bounded number of pull rounds once
+  the nemesis stops;
+* **prefix oracle** — the converged state contains EXACTLY the per-writer
+  contiguous prefix the fleet's vv claims, keyed against the driver's own
+  write ledger (no loss under the vv, no ghosts above it);
+* **duplicate / reorder idempotence** — after convergence, re-applying a
+  full payload twice and an older delta after it leaves state and vv
+  byte-identical (the state-based join laws, PAPERS.md);
+* **recovery provenance** — a deliberately planted corrupt snapshot is
+  quarantined (``snapshot_quarantine`` in the JSONL black box) and the
+  node restores from the PREVIOUS generation (``snapshot_restore`` with
+  ``fallback=true``); every wire-corruption that reached a node shows up
+  as a ``payload_quarantine`` event — degradation, never a dead loop.
+
+Determinism: the fault log records step indices only (no wall clock, no
+URLs); circuit breakers run on a step-indexed clock and per-edge seeded
+jitter.  Two same-seed runs therefore produce BYTE-IDENTICAL fault logs
+— ``--replay-check`` pins exactly that, and a failing seed replays from
+nothing but its number.
+
+    python -m crdt_tpu.harness.nemesis_soak --nodes 2 --steps 80 --seeds 1
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import random
+import tempfile
+from typing import Dict, List, Optional
+
+from crdt_tpu.faults import (
+    FaultPlane,
+    FaultyDisk,
+    FaultyTransport,
+    NemesisSchedule,
+    plant_corruption,
+)
+from crdt_tpu.harness.crashsoak import RID_STRIDE, _free_ports
+from crdt_tpu.obs import health
+from crdt_tpu.obs.events import read_jsonl
+from crdt_tpu.utils.config import ClusterConfig
+
+
+@dataclasses.dataclass
+class NemesisReport:
+    seed: int
+    steps: int
+    nodes: int
+    writes: int = 0
+    pulls: int = 0
+    merges: int = 0
+    backoff_skips: int = 0
+    checkpoints: int = 0
+    torn_writes: int = 0
+    crashes: int = 0
+    reboots: int = 0
+    barriers: int = 0
+    heal_rounds: int = 0
+    fault_counts: Dict[str, int] = dataclasses.field(default_factory=dict)
+    payload_quarantines: int = 0
+    snapshot_quarantines: int = 0
+    final_keys: int = 0
+
+    def summary(self) -> str:
+        faults = ", ".join(
+            f"{k}={v}" for k, v in sorted(self.fault_counts.items())
+        )
+        return (
+            f"seed {self.seed}: {self.steps} steps x {self.nodes} nodes — "
+            f"{self.writes} writes, {self.pulls} pulls ({self.merges} "
+            f"merged, {self.backoff_skips} breaker-skipped), "
+            f"{self.crashes} crashes / {self.reboots} reboots, "
+            f"{self.checkpoints} checkpoints ({self.torn_writes} torn), "
+            f"{self.barriers} barriers; faults: [{faults}]; quarantines: "
+            f"{self.payload_quarantines} payload / "
+            f"{self.snapshot_quarantines} snapshot; converged in "
+            f"{self.heal_rounds} heal rounds to {self.final_keys} keys"
+        )
+
+
+class _Slot:
+    """One replica slot: a stable port + checkpoint dir across an
+    in-process NodeHost per boot (the nemesis analogue of crashsoak's
+    subprocess Daemon)."""
+
+    def __init__(self, soak: "NemesisSoak", slot: int, port: int,
+                 peer_slots: List[int], peer_ports: List[int]):
+        self.soak = soak
+        self.slot = slot
+        self.port = port
+        self.peer_slots = peer_slots
+        self.peer_urls = [f"http://127.0.0.1:{p}" for p in peer_ports]
+        self.ckpt_dir = str(pathlib.Path(soak.root) / f"node{slot}")
+        self.disk = FaultyDisk(soak.plane, str(slot))
+        self.boots = 0
+        self.host = None
+        self.transports: Dict[int, FaultyTransport] = {}
+
+    @property
+    def event_log_path(self) -> str:
+        return str(pathlib.Path(self.ckpt_dir) / "events.jsonl")
+
+    @property
+    def alive(self) -> bool:
+        return self.host is not None
+
+    def boot(self) -> None:
+        from crdt_tpu.api.net import NodeHost
+        from crdt_tpu.utils import checkpoint as ckpt
+
+        assert self.host is None
+        inc = ckpt.bump_incarnation(self.ckpt_dir)
+        rid = self.slot + RID_STRIDE * inc
+        self.boots += 1
+        self.host = NodeHost(
+            rid=rid, peers=self.peer_urls, port=self.port,
+            config=self.soak.config, coordinator=(self.slot == 0),
+            checkpoint_dir=self.ckpt_dir,
+            event_log=self.event_log_path,
+        )
+        # swap the agent's peer clients for fault-plane shims: every wire
+        # interaction of the runtime under test now crosses the nemesis.
+        # Breakers run on the plane's STEP clock and per-edge seeded
+        # jitter so backoff windows replay identically under one seed.
+        plane = self.soak.plane
+        self.transports = {
+            j: FaultyTransport(
+                url, plane, src=str(self.slot), dst=str(j),
+                timeout=2.0, backoff_base_s=1.0, backoff_cap_s=5.0,
+                rng=random.Random(
+                    f"nemesis-breaker:{self.soak.seed}:{self.slot}:{j}"
+                ),
+                clock=lambda: float(plane.step),
+            )
+            for j, url in zip(self.peer_slots, self.peer_urls)
+        }
+        self.host.agent.peers = list(self.transports.values())
+        self.host.start_server()
+
+    def crash(self) -> None:
+        """SIGKILL analogue: the server vanishes mid-conversation; no stop
+        event, no final checkpoint — un-gossiped, un-snapshotted writes of
+        this incarnation die with it."""
+        assert self.host is not None
+        self.host.stop_server()
+        self.host.node.events.close()
+        self.host = None
+        self.transports = {}
+
+
+class NemesisSoak:
+    def __init__(self, seed: int, nodes: int = 3, steps: int = 120,
+                 fault_log: Optional[str] = None):
+        assert nodes >= 2, "nemesis needs a fleet (>= 2 nodes)"
+        self.seed = seed
+        self.steps = steps
+        self._tmp = tempfile.TemporaryDirectory(prefix="nemesis_soak_")
+        self.root = self._tmp.name
+        self.schedule = NemesisSchedule.generate(seed, nodes, steps)
+        self.plane = FaultPlane(self.schedule, log_path=fault_log)
+        self.config = ClusterConfig(
+            n_replicas=nodes, seed=seed,
+            gossip_period_ms=600_000,  # external drive only (determinism)
+            peer_timeout_s=2.0,
+            peer_backoff_base_s=1.0, peer_backoff_cap_s=5.0,
+        )
+        self.rng = random.Random(f"nemesis-soak:{seed}")
+        ports = _free_ports(nodes)
+        self.slots = [
+            _Slot(self, i, ports[i],
+                  [j for j in range(nodes) if j != i],
+                  [ports[j] for j in range(nodes) if j != i])
+            for i in range(nodes)
+        ]
+        for s in self.slots:
+            s.boot()
+        # write ledger: wire rid -> how many commands that writer minted
+        # (key/value are derived from (rid, seq), so the ledger IS the
+        # prefix oracle)
+        self.writes: Dict[int, int] = {}
+        self.report = NemesisReport(seed=seed, steps=steps, nodes=nodes)
+
+    # ---- step-phase actions (all rng-scheduled, all deterministic) ----
+
+    def _alive(self) -> List[_Slot]:
+        return [s for s in self.slots if s.alive]
+
+    def _write(self) -> None:
+        slot = self.rng.choice(self._alive())
+        rid = slot.host.node.rid
+        seq = self.writes.get(rid, 0)
+        if slot.host.node.add_command({f"k{rid}-{seq}": f"v{rid}-{seq}"}):
+            self.writes[rid] = seq + 1
+            self.report.writes += 1
+
+    def _pull(self) -> None:
+        src = self.rng.choice(self._alive())
+        dst = self.rng.choice(src.peer_slots)
+        t = src.transports[dst]
+        if t.backed_off():
+            self.report.backoff_skips += 1
+            return
+        self.report.pulls += 1
+        if src.host.agent.pull_from(t):
+            self.report.merges += 1
+
+    def _checkpoint(self) -> None:
+        slot = self.rng.choice(self._alive())
+        h = slot.host
+        _, torn = slot.disk.save(
+            slot.ckpt_dir, h.node, set_node=h.set_node,
+            seq_node=h.seq_node, map_node=h.map_node,
+        )
+        self.report.checkpoints += 1
+        if torn:
+            self.report.torn_writes += 1
+
+    def _crash(self) -> None:
+        alive = self._alive()
+        if len(alive) < 2:
+            return  # always keep a survivor carrying the fleet's state
+        self.rng.choice(alive).crash()
+        self.report.crashes += 1
+
+    def _reboot(self) -> None:
+        dead = [s for s in self.slots if not s.alive]
+        if dead:
+            self.rng.choice(dead).boot()
+            self.report.reboots += 1
+
+    def _barrier(self) -> None:
+        coord = self.slots[0]
+        if coord.alive and coord.host.agent.compact_once():
+            self.report.barriers += 1
+
+    def step(self, step: int) -> None:
+        self.plane.step = step
+        for skew in self.plane.skews_at(step):
+            slot = self.slots[int(skew.node)]
+            if slot.alive:
+                # shrinking the epoch moves now_ms forward, growing it
+                # moves it back (clamped at 0 by HostClock)
+                slot.host.node.clock.epoch_ms -= skew.skew_ms
+                self.plane.record("clock_skew", node=skew.node,
+                                  skew_ms=skew.skew_ms)
+        action = self.rng.choices(
+            ("write", "pull", "checkpoint", "crash", "reboot", "barrier"),
+            weights=(45, 35, 8, 4, 6, 2),
+        )[0]
+        getattr(self, f"_{action}")()
+
+    # ---- heal phase: recovery provenance + convergence + oracle ----
+
+    def _plant_and_recover(self) -> None:
+        """The pinned recovery scenario: two clean generations, tear the
+        newest, reboot — the node must quarantine it and restore the
+        previous one, with the whole story in its JSONL black box."""
+        slot = self.slots[-1]
+        if not slot.alive:
+            slot.boot()
+            self.report.reboots += 1
+        h = slot.host
+        slot.disk.save(slot.ckpt_dir, h.node, set_node=h.set_node,
+                       seq_node=h.seq_node, map_node=h.map_node)
+        # this write rides ONLY the (about to be torn) newest generation
+        # and is never gossiped: the fallback restore must drop it, and
+        # the prefix oracle must see the fleet vv stop just short of it
+        rid = h.node.rid
+        seq = self.writes.get(rid, 0)
+        if h.node.add_command({f"k{rid}-{seq}": f"v{rid}-{seq}"}):
+            self.writes[rid] = seq + 1
+            self.report.writes += 1
+        snap_b, _ = slot.disk.save(
+            slot.ckpt_dir, h.node, set_node=h.set_node,
+            seq_node=h.seq_node, map_node=h.map_node,
+        )
+        self.report.checkpoints += 2
+        slot.crash()
+        torn = plant_corruption(
+            slot.ckpt_dir, rng=random.Random(f"nemesis-plant:{self.seed}"))
+        assert torn == snap_b, (torn, snap_b)
+        slot.boot()
+        self.report.crashes += 1
+        self.report.reboots += 1
+        recs = read_jsonl(slot.event_log_path)
+        b_name = pathlib.Path(snap_b).name
+        quarantined = [e for e in recs
+                       if e.get("event") == "snapshot_quarantine"
+                       and e.get("snap") == b_name]
+        assert quarantined, (
+            f"planted corruption in {b_name} was restored without a "
+            "quarantine event"
+        )
+        restores = [e for e in recs if e.get("event") == "snapshot_restore"]
+        last = restores[-1] if restores else None
+        assert last and last.get("fallback") and last.get("verified"), (
+            f"expected a verified fallback restore after tearing {b_name}, "
+            f"got {last}"
+        )
+        quark = sorted(pathlib.Path(slot.ckpt_dir).glob("quarantine-*"))
+        assert quark, "quarantined snapshot dir missing from disk"
+
+    def _fleet_converged(self) -> bool:
+        states = []
+        for s in self.slots:
+            states.append((s.host.node.get_state(),
+                           s.host.node.version_vector()))
+        if any(st is None for st, _ in states):
+            return False
+        if any(t.pending_redelivery()
+               for s in self.slots for t in s.transports.values()):
+            return False
+        return all(st == states[0] for st in states[1:])
+
+    def _converge(self, max_rounds: int) -> None:
+        for r in range(1, max_rounds + 1):
+            self.plane.step += 1  # breakers keep aging; nemesis stays off
+            for src in self.slots:
+                for dst in src.peer_slots:
+                    t = src.transports[dst]
+                    if t.backed_off():
+                        continue
+                    src.host.agent.pull_from(t)
+                health.sample_peer_circuits(
+                    src.host.node.metrics.registry, str(src.slot),
+                    src.transports.values(),
+                )
+            if self._fleet_converged():
+                self.report.heal_rounds = r
+                return
+        raise AssertionError(
+            f"fleet failed to converge within {max_rounds} rounds after "
+            f"heal (seed {self.seed})"
+        )
+
+    def _check_prefix_oracle(self) -> None:
+        state = self.slots[0].host.node.get_state()
+        vv = self.slots[0].host.node.version_vector()
+        expected = {}
+        for rid, count in sorted(self.writes.items()):
+            upto = vv.get(rid, -1)
+            assert upto < count, (
+                f"fleet vv claims seq {upto} for writer {rid}, which only "
+                f"minted {count} ops (ghost writes)"
+            )
+            for seq in range(count):
+                key = f"k{rid}-{seq}"
+                if seq <= upto:
+                    expected[key] = f"v{rid}-{seq}"
+                else:
+                    assert key not in state, (
+                        f"{key} present above the vv prefix (seq {seq} > "
+                        f"{upto}): contiguity broken"
+                    )
+        assert state == expected, (
+            "converged state != vv-prefix fold of the write ledger: "
+            f"missing={sorted(set(expected) - set(state))[:5]} "
+            f"extra={sorted(set(state) - set(expected))[:5]}"
+        )
+        # every CURRENT incarnation survived to the heal, so none of its
+        # writes may have been lost
+        for s in self.slots:
+            rid = s.host.node.rid
+            if rid in self.writes:
+                assert vv.get(rid, -1) == self.writes[rid] - 1, (
+                    f"live writer {rid} lost writes: vv={vv.get(rid)} "
+                    f"ledger={self.writes[rid]}"
+                )
+        self.report.final_keys = len(state)
+
+    def _check_quarantine_provenance(self) -> None:
+        """The black box must account for every quarantine: snapshot
+        quarantine events match the quarantine- dirs on disk 1:1, and
+        every gossip corruption that got through the wire shows up as a
+        payload_quarantine event (the loop survived it)."""
+        gossip_corrupts = sum(
+            1 for rec in self.plane.log
+            if rec["fault"] == "corrupt" and rec.get("op") == "gossip"
+        )
+        payload_q = snap_q = 0
+        for s in self.slots:
+            recs = read_jsonl(s.event_log_path)
+            payload_q += sum(
+                1 for e in recs if e.get("event") == "payload_quarantine")
+            slot_snap_q = sum(
+                1 for e in recs if e.get("event") == "snapshot_quarantine")
+            on_disk = len(list(
+                pathlib.Path(s.ckpt_dir).glob("quarantine-*")))
+            assert slot_snap_q == on_disk, (
+                f"slot {s.slot}: {slot_snap_q} snapshot_quarantine events "
+                f"vs {on_disk} quarantined dirs on disk"
+            )
+            snap_q += slot_snap_q
+        assert payload_q == gossip_corrupts, (
+            f"{gossip_corrupts} corrupt gossip payloads were injected but "
+            f"{payload_q} payload_quarantine events were logged"
+        )
+        self.report.payload_quarantines = payload_q
+        self.report.snapshot_quarantines = snap_q
+
+    def _check_idempotence(self) -> None:
+        """Duplicate + reorder delivery against the CONVERGED fleet: a
+        full payload applied twice, then an OLDER delta applied after it,
+        must leave state and vv byte-identical (join idempotence +
+        monotonicity — the laws the message faults hammered all run)."""
+        a, b = self.slots[0].host.node, self.slots[1].host.node
+        snap = (json.dumps(a.get_state(), sort_keys=True),
+                a.version_vector())
+        full = b.gossip_payload(since=None)
+        a.receive(full)
+        a.receive(full)  # duplicate delivery
+        half_vv = {r: s // 2 for r, s in b.version_vector().items()}
+        a.receive(b.gossip_payload(since=half_vv))  # old-after-new
+        after = (json.dumps(a.get_state(), sort_keys=True),
+                 a.version_vector())
+        assert after == snap, (
+            "duplicate/reorder delivery mutated a converged node: "
+            f"{snap} -> {after}"
+        )
+
+    def heal_and_check(self, max_rounds: int = 80) -> NemesisReport:
+        self.plane.heal()
+        for s in self.slots:
+            if not s.alive:
+                s.boot()
+                self.report.reboots += 1
+        self._plant_and_recover()
+        self._converge(max_rounds)
+        self._check_prefix_oracle()
+        self._check_idempotence()
+        self._check_quarantine_provenance()
+        self.report.fault_counts = self.plane.counts()
+        return self.report
+
+    def close(self) -> None:
+        for s in self.slots:
+            if s.alive:
+                s.crash()
+        self.plane.close()
+        self._tmp.cleanup()
+
+    def run(self) -> NemesisReport:
+        try:
+            for i in range(self.steps):
+                self.step(i)
+            return self.heal_and_check()
+        finally:
+            self.close()
+
+
+def run_soak(seed: int, nodes: int, steps: int,
+             fault_log: Optional[str] = None) -> NemesisReport:
+    return NemesisSoak(seed, nodes=nodes, steps=steps,
+                       fault_log=fault_log).run()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="nemesis fault-injection soak")
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--seeds", type=int, default=1,
+                    help="run seeds 0..N-1")
+    ap.add_argument("--seed-base", type=int, default=0)
+    ap.add_argument("--fault-log", default=None,
+                    help="write the applied-fault JSONL here")
+    ap.add_argument("--replay-check", action="store_true",
+                    help="run each seed twice and require byte-identical "
+                         "fault logs (the determinism contract)")
+    args = ap.parse_args(argv)
+    for k in range(args.seeds):
+        seed = args.seed_base + k
+        if args.replay_check:
+            with tempfile.TemporaryDirectory(prefix="nemesis_replay_") as d:
+                log_a = str(pathlib.Path(d) / "a.jsonl")
+                log_b = str(pathlib.Path(d) / "b.jsonl")
+                rep = run_soak(seed, args.nodes, args.steps, fault_log=log_a)
+                run_soak(seed, args.nodes, args.steps, fault_log=log_b)
+                a = pathlib.Path(log_a).read_bytes()
+                b = pathlib.Path(log_b).read_bytes()
+                assert a == b, (
+                    f"seed {seed}: two runs diverged — fault logs differ "
+                    f"({len(a)} vs {len(b)} bytes); determinism broken"
+                )
+                print(f"[nemesis] replay-check OK: {rep.summary()}")
+        else:
+            rep = run_soak(seed, args.nodes, args.steps,
+                           fault_log=args.fault_log)
+            print(f"[nemesis] {rep.summary()}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

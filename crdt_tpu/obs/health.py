@@ -31,6 +31,10 @@ import time
 # EWMA weight of the newest pull-round lag observation (~last 5 rounds)
 LAG_ALPHA = 0.2
 
+# circuit-breaker state -> gauge value (net_peer_circuit_state{peer=}):
+# ordered by degradation so alert rules can threshold on > 0
+CIRCUIT_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
+
 
 def observe_pull_lag(registry, node_label: str, peer: str,
                      ops_behind: int) -> None:
@@ -116,8 +120,31 @@ def sample_map_node(registry, mn) -> None:
     registry.set_gauge("map_records", mn.n_records(), node=str(mn.rid))
 
 
+def sample_peer_circuits(registry, node_label: str, peers) -> None:
+    """Partition-state gauges from the NetworkAgent's RemotePeer circuit
+    breakers: per-peer breaker state (0 closed / 1 half-open / 2 open),
+    the consecutive-transport-failure count behind it, and the fleet-view
+    rollup (``net_peers_unreachable`` over ``net_peers_total``) that makes
+    an asymmetric partition directly scrapeable — THIS side of a one-way
+    cut shows open breakers while the far side stays green."""
+    peers = list(peers)
+    unreachable = 0
+    for p in peers:
+        state = p.circuit_state()
+        registry.set_gauge("net_peer_circuit_state",
+                           CIRCUIT_STATE_VALUE.get(state, 2),
+                           node=node_label, peer=p.url)
+        registry.set_gauge("net_peer_failures", p.failures,
+                           node=node_label, peer=p.url)
+        if state != "closed":
+            unreachable += 1
+    registry.set_gauge("net_peers_unreachable", unreachable,
+                       node=node_label)
+    registry.set_gauge("net_peers_total", len(peers), node=node_label)
+
+
 def sample_all(registry, node, set_node=None, seq_node=None,
-               map_node=None) -> None:
+               map_node=None, agent=None) -> None:
     sample_kv_node(registry, node)
     if set_node is not None:
         sample_set_node(registry, set_node)
@@ -125,13 +152,15 @@ def sample_all(registry, node, set_node=None, seq_node=None,
         sample_seq_node(registry, seq_node)
     if map_node is not None:
         sample_map_node(registry, map_node)
+    if agent is not None:
+        sample_peer_circuits(registry, str(node.rid), agent.peers)
 
 
 def render_node_metrics(node, set_node=None, seq_node=None,
-                        map_node=None) -> str:
+                        map_node=None, agent=None) -> str:
     """The GET /metrics body: sample health gauges into the node's
     registry, then render the whole registry as Prometheus text."""
     registry = node.metrics.registry
     sample_all(registry, node, set_node=set_node, seq_node=seq_node,
-               map_node=map_node)
+               map_node=map_node, agent=agent)
     return registry.render_prometheus()

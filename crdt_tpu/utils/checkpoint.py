@@ -25,13 +25,30 @@ restores safe in a LIVE fleet:
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import pathlib
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
+
+MANIFEST_NAME = "MANIFEST.json"
+QUARANTINE_PREFIX = "quarantine-"
+
+# fault-injection indirection (crdt_tpu.faults.disk.fsync_stall): a slow
+# or hung fsync is a real disk failure mode and must be injectable without
+# monkeypatching the os module fleet-wide
+_FSYNC_STALL_S = 0.0
+
+
+def _fsync(fd: int) -> None:
+    if _FSYNC_STALL_S > 0:
+        import time
+
+        time.sleep(_FSYNC_STALL_S)
+    os.fsync(fd)
 
 
 def _interner_dump(interner) -> list:
@@ -142,8 +159,75 @@ def _replace_file(path: pathlib.Path, data: str) -> None:
     with open(tmp, "w") as f:
         f.write(data)
         f.flush()
-        os.fsync(f.fileno())
+        _fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def _sha256_file(path: pathlib.Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_manifest(path: str) -> Dict[str, str]:
+    """Write a per-file SHA-256 integrity manifest into snapshot dir
+    ``path`` (every regular file except the manifest itself).  Written into
+    the STAGING dir before the atomic rename, so a published snapshot
+    always carries its own checksums — the restore side can then tell a
+    torn/bit-rotted snapshot from an intact one instead of crashing on it
+    (load_latest_node)."""
+    p = pathlib.Path(path)
+    files = {
+        f.name: _sha256_file(f)
+        for f in sorted(p.iterdir())
+        if f.is_file() and f.name != MANIFEST_NAME
+    }
+    _replace_file(p / MANIFEST_NAME, json.dumps({"files": files},
+                                                sort_keys=True))
+    return files
+
+
+def verify_snapshot(path: str) -> Optional[str]:
+    """Integrity-check one snapshot dir against its manifest.  Returns None
+    when intact (or when the snapshot predates manifests — restore_node's
+    own parsing still guards those), else a short reason string."""
+    p = pathlib.Path(path)
+    if not p.is_dir():
+        return "missing snapshot directory"
+    mf = p / MANIFEST_NAME
+    if not mf.is_file():
+        return None  # legacy pre-manifest snapshot: nothing to check against
+    try:
+        manifest = json.loads(mf.read_text())
+        files = manifest["files"]
+    except (ValueError, KeyError, TypeError) as e:
+        return f"unreadable manifest: {e}"
+    for name, want in sorted(files.items()):
+        f = p / name
+        if not f.is_file():
+            return f"manifest file missing: {name}"
+        if _sha256_file(f) != want:
+            return f"digest mismatch: {name}"
+    return None
+
+
+def _quarantine_snap(rootp: pathlib.Path, snap: pathlib.Path) -> None:
+    """Move a corrupt snapshot out of the ``snap-*`` namespace (so neither
+    restores nor save_node_atomic's numbering/pruning ever touch it again)
+    while preserving it on disk for forensics."""
+    if not snap.exists():
+        return
+    dest = rootp / f"{QUARANTINE_PREFIX}{snap.name}"
+    i = 0
+    while dest.exists():
+        i += 1
+        dest = rootp / f"{QUARANTINE_PREFIX}{snap.name}.{i}"
+    try:
+        snap.rename(dest)
+    except OSError:
+        pass  # cross-device/permission oddity: leave it; globs still skip it
 
 
 def save_node_atomic(root: str, node, set_node=None, seq_node=None,
@@ -171,6 +255,10 @@ def save_node_atomic(root: str, node, set_node=None, seq_node=None,
     with node._lock:
         save_node(str(staging), node, set_node=set_node, seq_node=seq_node,
                   map_node=map_node)
+    # integrity manifest INSIDE the staging dir: the rename publishes the
+    # snapshot and its checksums as one unit (a snapshot without a complete
+    # manifest can only be a legacy one)
+    write_manifest(str(staging))
     final = rootp / f"snap-{n:08d}"
     os.rename(staging, final)  # same fs: atomic
     _replace_file(latest, final.name)
@@ -185,16 +273,58 @@ def save_node_atomic(root: str, node, set_node=None, seq_node=None,
 
 def load_latest_node(root: str, node, allow_rid_change: bool = True,
                      set_node=None, seq_node=None, map_node=None) -> bool:
-    """Restore the newest complete snapshot under ``root`` into ``node``;
-    False when none exists (fresh boot)."""
+    """Restore the newest intact snapshot under ``root`` into ``node``;
+    False when none restores (fresh boot).
+
+    Candidate order: the snapshot LATEST names first, then every other
+    ``snap-*`` dir newest-first (a kill between save_node_atomic's rename
+    and the LATEST repoint leaves a newer orphan; a torn disk can leave
+    LATEST pointing at a missing or corrupt dir — both previously raised
+    and killed the boot).  Each candidate is verified against its SHA-256
+    manifest before restoring; a candidate that fails verification OR
+    restore is QUARANTINED — ``snapshot_quarantine`` event + metric, dir
+    renamed out of the snap namespace — and the next generation is tried.
+    The chosen restore is recorded as a ``snapshot_restore`` event with
+    its provenance (which snap, whether it was the LATEST target, whether
+    a manifest vouched for it), so the crash-soak black box can audit
+    recovery end-to-end."""
     rootp = pathlib.Path(root)
     latest = rootp / "LATEST"
-    if not latest.exists():
-        return False
-    snap = rootp / latest.read_text().strip()
-    restore_node(str(snap), node, allow_rid_change=allow_rid_change,
-                 set_node=set_node, seq_node=seq_node, map_node=map_node)
-    return True
+    latest_name = latest.read_text().strip() if latest.exists() else ""
+    candidates = []
+    if latest_name:
+        candidates.append(rootp / latest_name)
+    for p in sorted(rootp.glob("snap-*"), reverse=True):
+        if p.name != latest_name:
+            candidates.append(p)
+    for snap in candidates:
+        err = verify_snapshot(str(snap))
+        if err is None:
+            try:
+                # (a restore failure may leave interner strings behind;
+                # that is benign — ids are append-only and unused entries
+                # carry no semantics — and the next candidate's restore
+                # overwrites log/commands/frontier wholesale)
+                restore_node(str(snap), node,
+                             allow_rid_change=allow_rid_change,
+                             set_node=set_node, seq_node=seq_node,
+                             map_node=map_node)
+            except Exception as e:  # noqa: BLE001 — quarantined loudly below
+                err = f"restore failed: {type(e).__name__}: {e}"
+        if err is not None:
+            node.metrics.inc("snapshot_quarantines")
+            node.events.emit("snapshot_quarantine", snap=snap.name,
+                             reason=str(err)[:200])
+            _quarantine_snap(rootp, snap)
+            continue
+        node.metrics.inc("snapshot_restores")
+        node.events.emit(
+            "snapshot_restore", snap=snap.name,
+            fallback=snap.name != latest_name,
+            verified=(snap / MANIFEST_NAME).is_file(),
+        )
+        return True
+    return False
 
 
 def bump_incarnation(root: str) -> int:

@@ -86,6 +86,15 @@ class ClusterConfig:
     # NOT backed off: it costs the round nothing and may revive any time.
     peer_backoff_base_s: float = 0.5
     peer_backoff_cap_s: float = 30.0
+    # circuit breaker on the same transport-failure signal: after
+    # peer_failure_threshold CONSECUTIVE transport failures the peer's
+    # breaker opens, the skip window is drawn with DECORRELATED JITTER
+    # (min(cap, U(base, 3*prev)) — a fleet of agents must not re-probe a
+    # revived peer in lockstep), and when the window expires the breaker
+    # goes HALF-OPEN: exactly one probe request is admitted; success closes
+    # the breaker, failure re-opens it with a fresh jittered window.
+    # 1 = trip on the first failure (the pre-breaker skip behavior).
+    peer_failure_threshold: int = 1
 
     def ports(self) -> List[int]:
         return [self.base_port + i for i in range(self.n_replicas)]

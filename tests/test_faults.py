@@ -1,0 +1,398 @@
+"""Fault plane: per-injector pins, SHA-256 manifest round-trip, the
+LATEST-fallback recovery path, circuit-breaker half-open transitions,
+schedule determinism, and a small end-to-end nemesis smoke."""
+import json
+import pathlib
+import random
+import time
+
+import pytest
+
+from crdt_tpu.api.net import NetworkAgent, NodeHost, RemotePeer
+from crdt_tpu.api.node import ReplicaNode
+from crdt_tpu.faults import (
+    FaultPlane,
+    FaultRule,
+    FaultyDisk,
+    FaultyTransport,
+    NemesisSchedule,
+    fsync_stall,
+    plant_corruption,
+    point_latest_at_missing,
+    tear_snapshot,
+)
+from crdt_tpu.obs import health
+from crdt_tpu.utils import checkpoint
+from crdt_tpu.utils.config import ClusterConfig
+from crdt_tpu.utils.metrics import Metrics
+
+
+def _events(node, name):
+    return [e for e in node.events.tail(100) if e.get("event") == name]
+
+
+def _plane(*rules, seed=0):
+    return FaultPlane(NemesisSchedule(
+        seed=seed, steps=1000, nodes=2, rules=tuple(rules), skews=(),
+    ))
+
+
+@pytest.fixture
+def served():
+    """One serving NodeHost with a little state (the gossip source)."""
+    host = NodeHost(rid=1, peers=[], port=0)
+    host.node.add_command({"x": "1"}, ts=10)
+    host.node.add_command({"y": "2"}, ts=11)
+    host.start_server()
+    yield host
+    host.stop_server()
+
+
+def _puller(rid=0):
+    node = ReplicaNode(rid=rid, capacity=64)
+    agent = NetworkAgent(node, [], ClusterConfig())
+    return node, agent
+
+
+# ---- snapshot integrity: manifest round-trip + fallback restore ----
+
+
+def test_manifest_roundtrip_and_tamper_detection(tmp_path):
+    n = ReplicaNode(rid=0, capacity=32)
+    n.add_command({"x": "5"}, ts=10)
+    snap = checkpoint.save_node_atomic(tmp_path, n)
+    manifest = json.loads(
+        (pathlib.Path(snap) / checkpoint.MANIFEST_NAME).read_text())
+    assert set(manifest["files"]) == {"log.npz", "meta.json"}
+    assert checkpoint.verify_snapshot(snap) is None
+
+    torn_file = tear_snapshot(snap, rng=random.Random("t"))
+    assert checkpoint.verify_snapshot(snap) == f"digest mismatch: {torn_file}"
+    (pathlib.Path(snap) / torn_file).unlink()
+    assert checkpoint.verify_snapshot(snap) == (
+        f"manifest file missing: {torn_file}")
+    assert checkpoint.verify_snapshot(tmp_path / "nope") == (
+        "missing snapshot directory")
+
+
+def test_latest_pointing_at_missing_dir_falls_back(tmp_path):
+    """Kill between prune and repoint: LATEST names a dir that is gone —
+    boot must restore the newest surviving snap, not crash."""
+    n = ReplicaNode(rid=0, capacity=32)
+    n.add_command({"x": "5"}, ts=10)
+    checkpoint.save_node_atomic(tmp_path, n)
+    point_latest_at_missing(tmp_path)
+
+    n2 = ReplicaNode(rid=0, capacity=32)
+    assert checkpoint.load_latest_node(tmp_path, n2)
+    assert n2.get_state() == {"x": "5"}
+    [q] = _events(n2, "snapshot_quarantine")
+    assert q["reason"] == "missing snapshot directory"
+    [r] = _events(n2, "snapshot_restore")
+    assert r["fallback"] and r["verified"]
+
+
+def test_corrupt_latest_restores_previous_generation(tmp_path):
+    n = ReplicaNode(rid=0, capacity=32)
+    n.add_command({"x": "5"}, ts=10)
+    checkpoint.save_node_atomic(tmp_path, n)
+    n.add_command({"y": "9"}, ts=11)
+    checkpoint.save_node_atomic(tmp_path, n)
+    torn = plant_corruption(tmp_path)  # tears the LATEST target
+    torn_name = pathlib.Path(torn).name
+
+    n2 = ReplicaNode(rid=0, capacity=32)
+    assert checkpoint.load_latest_node(tmp_path, n2)
+    # the torn generation (holding y) is quarantined; the previous one
+    # restores — losing y, which only ever lived in the damaged snap
+    assert n2.get_state() == {"x": "5"}
+    assert n2.metrics._counts["snapshot_quarantines"] == 1
+    assert n2.metrics._counts["snapshot_restores"] == 1
+    [q] = _events(n2, "snapshot_quarantine")
+    assert q["snap"] == torn_name and "digest mismatch" in q["reason"]
+    [r] = _events(n2, "snapshot_restore")
+    assert r["fallback"] and r["verified"] and r["snap"] != torn_name
+    # the damaged dir left the snap-* namespace but stayed for forensics
+    assert list(tmp_path.glob(f"quarantine-{torn_name}"))
+    assert not (tmp_path / torn_name).exists()
+
+
+def test_no_restorable_snapshot_returns_false(tmp_path):
+    n = ReplicaNode(rid=0, capacity=32)
+    assert not checkpoint.load_latest_node(tmp_path, n)  # empty root
+    n.add_command({"x": "1"}, ts=10)
+    snap = checkpoint.save_node_atomic(tmp_path, n)
+    tear_snapshot(snap)
+    n2 = ReplicaNode(rid=0, capacity=32)
+    assert not checkpoint.load_latest_node(tmp_path, n2)  # only snap torn
+    assert _events(n2, "snapshot_quarantine")
+
+
+def test_fsync_stall_injection(tmp_path):
+    n = ReplicaNode(rid=0, capacity=32)
+    n.add_command({"x": "1"}, ts=10)
+    t0 = time.perf_counter()
+    with fsync_stall(0.02):
+        checkpoint.save_node_atomic(tmp_path, n)
+    assert time.perf_counter() - t0 >= 0.02  # >=1 stalled fsync ran
+    assert checkpoint._FSYNC_STALL_S == 0.0  # restored on exit
+
+
+# ---- circuit breaker: half-open transitions + decorrelated jitter ----
+
+
+def test_circuit_breaker_half_open_transitions():
+    now = {"t": 0.0}
+    peer = RemotePeer("http://127.0.0.1:9", backoff_base_s=1.0,
+                      backoff_cap_s=30.0, rng=random.Random("cb"),
+                      clock=lambda: now["t"])
+    assert peer.circuit_state() == "closed" and not peer.backed_off()
+    peer._note_transport_failure()
+    assert peer.circuit_state() == "open" and peer.backed_off()
+    assert peer.failures == 1
+    assert 1.0 <= peer.retry_at <= 3.0  # first window: U(base, 3*base)
+
+    now["t"] = peer.retry_at + 0.01  # window expired
+    assert not peer.backed_off()  # this caller IS the half-open probe
+    assert peer.circuit_state() == "half_open"
+    assert peer.backed_off()  # everyone else keeps waiting on the probe
+
+    peer._note_transport_failure()  # probe failed: re-open, fresh window
+    assert peer.circuit_state() == "open" and peer.backed_off()
+    now["t"] = peer.retry_at + 0.01
+    assert not peer.backed_off()  # next probe
+    peer._note_reachable()  # probe succeeded
+    assert peer.circuit_state() == "closed"
+    assert peer.failures == 0 and not peer.backed_off()
+
+
+def test_backoff_jitter_is_decorrelated_and_capped():
+    deadlines = set()
+    for s in range(6):
+        p = RemotePeer("http://127.0.0.1:9", backoff_base_s=0.5,
+                       backoff_cap_s=4.0, rng=random.Random(f"j{s}"),
+                       clock=lambda: 0.0)
+        for _ in range(8):
+            p._note_transport_failure()
+            assert 0.5 <= p._delay <= 4.0  # jittered, never past the cap
+        deadlines.add(p.retry_at)
+    # different agents must NOT re-probe a revived peer in lockstep
+    assert len(deadlines) > 1
+
+
+def test_failure_threshold_gates_the_breaker():
+    peer = RemotePeer("http://127.0.0.1:9", failure_threshold=3,
+                      rng=random.Random("th"), clock=lambda: 0.0)
+    peer._note_transport_failure()
+    peer._note_transport_failure()
+    assert peer.circuit_state() == "closed" and not peer.backed_off()
+    peer._note_transport_failure()  # third consecutive failure trips it
+    assert peer.circuit_state() == "open" and peer.backed_off()
+
+
+def test_circuit_state_gauges(served):
+    peer = RemotePeer("http://127.0.0.1:9", clock=lambda: 0.0,
+                      rng=random.Random("g"))
+    peer._note_transport_failure()
+    m = Metrics()
+    health.sample_peer_circuits(m.registry, "0", [peer])
+    assert m.registry.gauge_value("net_peer_circuit_state", node="0",
+                                  peer=peer.url) == 2  # open
+    assert m.registry.gauge_value("net_peers_unreachable", node="0") == 1
+    assert m.registry.gauge_value("net_peers_total", node="0") == 1
+    # and the served /metrics endpoint samples its agent's breakers
+    import urllib.request
+
+    with urllib.request.urlopen(served.url + "/metrics", timeout=5) as res:
+        body = res.read().decode()
+    assert "net_peers_total" in body
+
+
+# ---- wire injectors, pinned one at a time ----
+
+
+def test_drop_injector_counts_transport_failure(served):
+    node, agent = _puller()
+    t = FaultyTransport(served.url, _plane(FaultRule("drop")), "0", "1")
+    assert not agent.pull_from(t)
+    assert node.get_state() == {}
+    assert t.failures == 1 and t.circuit_state() == "open"
+    assert agent.metrics._counts["net_gossip_skipped"] == 1
+    assert [r["fault"] for r in t.plane.log] == ["drop"]
+
+
+def test_truncate_injector_skips_never_partially_merges(served):
+    node, agent = _puller()
+    t = FaultyTransport(served.url, _plane(FaultRule("truncate")), "0", "1")
+    assert not agent.pull_from(t)
+    # a cut body must surface as NO payload — a partial merge would leave
+    # a permanent hole under the version vector
+    assert node.get_state() == {} and node.version_vector() == {}
+    assert agent.metrics._counts["net_gossip_skipped"] == 1
+    t.plane.heal()
+    assert agent.pull_from(t)  # transport recovers instantly after heal
+    assert node.get_state() == served.node.get_state()
+
+
+def test_corrupt_injector_quarantines_and_loop_survives(served):
+    node, agent = _puller()
+    t = FaultyTransport(served.url, _plane(FaultRule("corrupt")), "0", "1")
+    assert not agent.pull_from(t)  # mangled payload: quarantined, not fatal
+    assert node.get_state() == {}
+    assert agent.metrics._counts["net_gossip_quarantined"] == 1
+    [q] = _events(node, "payload_quarantine")
+    assert q["surface"] == "net_gossip" and "ValueError" in q["error"]
+    t.plane.heal()
+    assert agent.pull_from(t)  # the reference's loop would be dead here
+    assert node.get_state() == served.node.get_state()
+
+
+def test_duplicate_injector_second_delivery_noops(served):
+    node, agent = _puller()
+    t = FaultyTransport(served.url, _plane(FaultRule("duplicate")), "0", "1")
+    assert agent.pull_from(t)  # delivered AND queued for redelivery
+    assert t.pending_redelivery() == 1
+    state = json.dumps(node.get_state(), sort_keys=True)
+    vv = node.version_vector()
+    assert not agent.pull_from(t)  # identical bytes again: semantic no-op
+    assert t.pending_redelivery() == 0
+    assert json.dumps(node.get_state(), sort_keys=True) == state
+    assert node.version_vector() == vv
+
+
+def test_reorder_injector_old_after_new_noops(served):
+    node, agent = _puller()
+    plane = _plane(FaultRule("reorder", end=1))  # holds step 0 only
+    t = FaultyTransport(served.url, plane, "0", "1")
+    assert not agent.pull_from(t)  # payload withheld: empty delta
+    assert t.pending_redelivery() == 1 and node.get_state() == {}
+    plane.step = 1
+    served.node.add_command({"z": "7"}, ts=12)  # newer state arrives first
+    node.receive(served.node.gossip_payload())
+    state = json.dumps(node.get_state(), sort_keys=True)
+    vv = node.version_vector()
+    assert not agent.pull_from(t)  # held OLD payload lands after: no-op
+    assert t.pending_redelivery() == 0
+    assert json.dumps(node.get_state(), sort_keys=True) == state
+    assert node.version_vector() == vv
+
+
+def test_delay_injector_sleeps_but_delivers(served):
+    node, agent = _puller()
+    t = FaultyTransport(
+        served.url, _plane(FaultRule("delay", arg=0.01)), "0", "1")
+    t0 = time.perf_counter()
+    assert agent.pull_from(t)
+    assert time.perf_counter() - t0 >= 0.01
+    assert node.get_state() == served.node.get_state()
+
+
+# ---- NetworkAgent-layer duplicate/reorder idempotence (scripted peer) ----
+
+
+class _ScriptedPeer:
+    """Duck-typed RemotePeer: serves a fixed payload sequence."""
+
+    url = "scripted://peer"
+
+    def __init__(self, payloads):
+        self.payloads = list(payloads)
+
+    def gossip_payload(self, since=None, trace=None):
+        return self.payloads.pop(0) if self.payloads else {}
+
+
+def test_agent_duplicate_and_reorder_delivery_idempotent():
+    src = ReplicaNode(rid=1, capacity=64)
+    src.add_command({"a": "1"}, ts=10)
+    older = src.gossip_payload()  # pre-update payload
+    src.add_command({"b": "2"}, ts=11)
+    newer = src.gossip_payload()
+
+    node, agent = _puller()
+    # newer twice (duplicate), then older after newer (reorder)
+    peer = _ScriptedPeer([newer, newer, older])
+    assert agent.pull_from(peer)
+    state = json.dumps(node.get_state(), sort_keys=True)
+    vv = node.version_vector()
+    assert not agent.pull_from(peer)  # duplicate: no-op
+    assert not agent.pull_from(peer)  # out-of-order old payload: no-op
+    assert json.dumps(node.get_state(), sort_keys=True) == state
+    assert node.version_vector() == vv
+    assert state == json.dumps(src.get_state(), sort_keys=True)
+
+
+def test_validate_payload_flags_malformed_bodies(served):
+    node = ReplicaNode(rid=0, capacity=32)
+    good = served.node.gossip_payload()
+    assert node.validate_payload(good) is None
+    assert "ValueError" in node.validate_payload(
+        {"nemesis:corrupt:key": {"a": "b"}})
+    bad_cmd = dict(good)
+    wire_key = next(k for k in bad_cmd if not k.startswith("__"))
+    bad_cmd[wire_key] = "not-a-dict"
+    assert "non-dict command" in node.validate_payload(bad_cmd)
+
+
+# ---- schedule/plane determinism + disk shim ----
+
+
+def test_schedule_generation_is_deterministic():
+    a = NemesisSchedule.generate(7, 3, 100)
+    b = NemesisSchedule.generate(7, 3, 100)
+    assert a == b
+    assert a != NemesisSchedule.generate(8, 3, 100)
+    assert NemesisSchedule.from_json(a.to_json()) == a
+    assert a.rules and any(r.kind == "drop" for r in a.rules)
+
+
+def test_plane_decisions_replay_identically():
+    sched = NemesisSchedule.generate(7, 3, 100)
+    p1, p2 = FaultPlane(sched), FaultPlane(sched)
+    for step in (0, 3, 17, 50):
+        p1.step = p2.step = step
+        for src, dst in (("0", "1"), ("1", "2"), ("2", "0")):
+            assert p1.decide(src, dst, "gossip") == p2.decide(
+                src, dst, "gossip")
+    p1.heal()
+    assert p1.decide("0", "1", "gossip") == {}
+
+
+def test_fault_log_is_step_indexed_without_wall_time(tmp_path):
+    log_path = tmp_path / "faults.jsonl"
+    plane = FaultPlane(NemesisSchedule(seed=0, steps=10, nodes=2,
+                                       rules=(), skews=()),
+                       log_path=str(log_path))
+    plane.step = 3
+    plane.record("drop", src="0", dst="1", op="gossip")
+    plane.heal()
+    plane.close()
+    recs = [json.loads(line) for line in log_path.read_text().splitlines()]
+    assert recs == [
+        {"step": 3, "fault": "drop", "src": "0", "dst": "1",
+         "op": "gossip"},
+        {"step": 3, "fault": "heal"},
+    ]
+
+
+def test_faulty_disk_torn_write_detected_on_restore(tmp_path):
+    plane = _plane(FaultRule("truncate", op="disk"))
+    disk = FaultyDisk(plane, "0")
+    n = ReplicaNode(rid=0, capacity=32)
+    n.add_command({"x": "1"}, ts=10)
+    snap, torn = disk.save(str(tmp_path), n)
+    assert torn
+    assert checkpoint.verify_snapshot(snap) is not None
+    assert any(r["fault"] == "torn_write" for r in plane.log)
+    n2 = ReplicaNode(rid=0, capacity=32)
+    assert not checkpoint.load_latest_node(tmp_path, n2)  # only snap torn
+
+
+# ---- end-to-end smoke ----
+
+
+def test_nemesis_soak_smoke():
+    from crdt_tpu.harness.nemesis_soak import run_soak
+
+    rep = run_soak(seed=0, nodes=2, steps=30)
+    assert rep.writes > 0 and rep.final_keys > 0
