@@ -485,9 +485,17 @@ class NetworkAgent:
         one bad payload, quirk §0.1.8; ours died loudly — still a total
         outage of the pull loop)."""
         tid = mint_trace_id(self.node.rid)
+
+        def fetch(since):
+            # timed separately from the merge: the fetch half of a round is
+            # network wall time, the denominator the propagation-seconds
+            # histogram (obs/provenance) should be read against
+            with self.metrics.timer("net_fetch"):
+                return peer.gossip_payload(since, trace=tid)
+
         return pull_round(
             self.node,
-            lambda since: peer.gossip_payload(since, trace=tid),
+            fetch,
             self.metrics,
             delta=self.config.delta_gossip,
             prefix="net_gossip",
@@ -795,6 +803,8 @@ class NodeHost:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every_s: float = 0,
         event_log: Optional[str] = None,
+        step_clock=None,
+        birth_ledger=None,
     ):
         from crdt_tpu.api.http_shim import _make_handler
         from crdt_tpu.api.mapnode import MapNode
@@ -819,8 +829,16 @@ class NodeHost:
         self.node = ReplicaNode(
             rid=rid, capacity=capacity or self.config.log_capacity,
             go_compat_gossip=self.config.go_compat_gossip,
-            events=EventLog(node=str(rid), path=event_log),
+            events=EventLog(node=str(rid), path=event_log,
+                            step_clock=step_clock),
         )
+        # flight recorder (crdt_tpu.obs.provenance): a soak harness passes
+        # its shared BirthLedger + step clock so propagation-steps
+        # histograms get a deterministic time base; installed BEFORE the
+        # boot event below so even boot carries a step stamp
+        if step_clock is not None or birth_ledger is not None:
+            self.install_flight_recorder(ledger=birth_ledger,
+                                         step_clock=step_clock)
         # the set-lattice sibling: same wire rid (namespaces are disjoint —
         # set vv/floor never mix with the KV vv/frontier), gossiped and
         # checkpointed alongside the KV node
@@ -866,6 +884,16 @@ class NodeHost:
         self._ckpt_stop = threading.Event()
         self._ckpt_thread: Optional[threading.Thread] = None
         self._ckpt_errors: List[Exception] = []
+
+    def install_flight_recorder(self, ledger=None, step_clock=None) -> None:
+        """Attach a shared BirthLedger / step clock to this host's flight
+        recorder (crdt_tpu.obs.provenance) and stamp subsequent events with
+        the driver step.  Idempotent; soak harnesses call this (or pass the
+        constructor kwargs) so propagation-steps lag uses their
+        deterministic time base."""
+        self.node.recorder.install(ledger=ledger, step_clock=step_clock)
+        if step_clock is not None:
+            self.node.events.step_clock = step_clock
 
     def start_server(self) -> None:
         """Serve the HTTP surface only (no background gossip) — for drivers

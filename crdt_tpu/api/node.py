@@ -21,13 +21,15 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from crdt_tpu.models import compactlog, oplog
-from crdt_tpu.obs import health
+from crdt_tpu.obs import devtime, health
 from crdt_tpu.obs.events import EventLog
+from crdt_tpu.obs.provenance import FlightRecorder
 from crdt_tpu.obs.trace import current_trace, span
 from crdt_tpu.utils.clock import HostClock, SeqGen
 from crdt_tpu.utils.intern import Interner, encode_value
@@ -276,6 +278,18 @@ class ReplicaNode:
         self.go_compat_gossip = bool(go_compat_gossip)
         self.clock = clock or HostClock()
         self.metrics = metrics or Metrics()
+        # convergence flight recorder (crdt_tpu.obs.provenance): birth
+        # stamps on the write path, vv-delta visibility on the merge path.
+        # Enablement rides registry.enabled, so a NULL_REGISTRY node pays
+        # nothing; drivers install a shared BirthLedger + step clock via
+        # recorder.install (the soak harnesses / NodeHost do)
+        self.recorder = FlightRecorder(
+            rid, self.metrics.registry, events=self.events
+        )
+        if self.events.registry is None:
+            # ring-eviction accounting (crdt_events_dropped_total) lands
+            # in this node's registry unless the log already has a sink
+            self.events.registry = self.metrics.registry
         # native C++ interner + batch packer when built (identical semantics,
         # tests/test_native.py); pure-Python otherwise
         self._native = native.AVAILABLE if use_native is None else use_native
@@ -347,6 +361,10 @@ class ReplicaNode:
             seq = self._seq.next()
             with self.metrics.timer("write"):
                 self._ingest([(ts, self.rid, seq, dict(cmd))])
+            if self.recorder.enabled:
+                # birth record (origin, seq, birth_step): the wire ts IS
+                # the op's absolute-ms birth timestamp every observer sees
+                self.recorder.note_birth(seq, ts + self.clock.epoch_ms)
             return True
 
     # ---- read path ----
@@ -558,14 +576,30 @@ class ReplicaNode:
         if not payload or not self.alive:
             return 0
         remote_frontier, remote_summary, rows = self._decode_payload(payload)
+        recording = self.recorder.enabled
+        vv_before = vv_after = None
         with self._lock:
             with self.metrics.timer("merge"), span("crdt.merge"):
+                if recording:
+                    vv_before = self._version_vector_locked()
                 adopted = 0
                 if remote_frontier:
                     adopted = self._adopt_frontier_locked(
                         remote_frontier, remote_summary
                     )
-                return self._ingest(rows) + adopted
+                fresh = self._ingest(rows)
+                if recording:
+                    vv_after = self._version_vector_locked()
+        if recording and vv_after != vv_before:
+            # newly-visible origin-seq ranges fall out of the vv delta —
+            # no per-op scan; duplicate/reordered deliveries (vv did not
+            # move) emit nothing, so exactly-once holds structurally
+            epoch = self.clock.epoch_ms
+            self.recorder.note_visible(
+                vv_before, vv_after,
+                births={(rid, seq): ts + epoch for ts, rid, seq, _ in rows},
+            )
+        return fresh + adopted
 
     def receive_many(self, payloads: List[Dict[str, Any]]) -> int:
         """K-way FUSED merge: absorb several peers' gossip payloads in ONE
@@ -588,8 +622,12 @@ class ReplicaNode:
         ]
         if not decoded:
             return 0
+        recording = self.recorder.enabled
+        vv_before = vv_after = None
         with self._lock:
             with self.metrics.timer("merge"), span("crdt.merge_fused"):
+                if recording:
+                    vv_before = self._version_vector_locked()
                 adopted = 0
                 rows_all: List[Tuple[int, int, int, Dict[str, str]]] = []
                 for remote_frontier, remote_summary, rows in decoded:
@@ -598,7 +636,19 @@ class ReplicaNode:
                             remote_frontier, remote_summary
                         )
                     rows_all.extend(rows)
-                return self._ingest(rows_all) + adopted
+                fresh = self._ingest(rows_all)
+                if recording:
+                    vv_after = self._version_vector_locked()
+        if recording and vv_after != vv_before:
+            # one vv delta covers the whole fused round: per (origin, seq)
+            # the k payloads' duplicates collapse to one visibility
+            epoch = self.clock.epoch_ms
+            self.recorder.note_visible(
+                vv_before, vv_after,
+                births={(rid, seq): ts + epoch
+                        for ts, rid, seq, _ in rows_all},
+            )
+        return fresh + adopted
 
     # ---- health / fault injection ----
 
@@ -921,10 +971,21 @@ class ReplicaNode:
         # donated: it is rebound right below under the node lock, so XLA
         # may write the union into its buffers (TPU/GPU; plain jit on CPU).
         self.metrics.inc("merge_dispatches")
-        merged, n_unique = oplog.merge_checked_donating(
-            self.log, oplog.from_ops(batch_cap, ops)
-        )
+        batch = oplog.from_ops(batch_cap, ops)
+        timing = self.recorder.enabled
+        t0 = time.perf_counter() if timing else 0.0
+        with devtime.dispatch_annotation("merge", enabled=timing):
+            merged, n_unique = oplog.merge_checked_donating(self.log, batch)
+        # int(n_unique) is a host sync: by the time the assert runs the
+        # dispatch has completed, so t1 - t0 is true device+dispatch wall
+        # time — the denominator of the roofline ratio (obs/devtime)
         assert int(n_unique) <= self.log.capacity
+        if timing:
+            devtime.observe_join(
+                self.metrics.registry, str(self.rid),
+                oplog.merge_checked_donating, (self.log, batch),
+                time.perf_counter() - t0,
+            )
         self.log = merged
         self.metrics.inc("ops_ingested", fresh)
         return fresh

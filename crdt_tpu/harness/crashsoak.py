@@ -269,7 +269,10 @@ class CrashSoakRunner:
     """One seeded kill/restore schedule against a subprocess daemon fleet."""
 
     def __init__(self, n: int = 3, seed: int = 0, n_keys: int = 6,
-                 workdir: Optional[str] = None):
+                 workdir: Optional[str] = None,
+                 postmortem_dir: Optional[str] = None):
+        self.seed = seed
+        self.postmortem_dir = postmortem_dir
         self.rng = random.Random(seed)
         self.keys = [f"k{i}" for i in range(n_keys)]
         self._tmp = (
@@ -1107,11 +1110,34 @@ class CrashSoakRunner:
         if self._tmp is not None:
             self._tmp.cleanup()
 
+    def write_postmortem(self) -> Optional[str]:
+        """Bundle every daemon's JSONL black box into
+        postmortem-<seed>.tar.gz (no fault log — this soak's only nemesis
+        is SIGKILL; the boot/restore provenance is in the events).  Must
+        run BEFORE close(): the logs live in the soak's temp dir."""
+        if self.postmortem_dir is None:
+            return None
+        from crdt_tpu.obs import assemble
+
+        out = str(pathlib.Path(self.postmortem_dir)
+                  / f"postmortem-{self.seed}.tar.gz")
+        try:
+            assemble.write_postmortem(
+                out, [d.event_log_path for d in self.daemons])
+        except OSError as e:
+            print(f"[crashsoak] postmortem bundling failed: {e}")
+            return None
+        print(f"[crashsoak] postmortem bundle: {out}")
+        return out
+
     def run(self, n_steps: int) -> CrashReport:
         try:
             for _ in range(n_steps):
                 self.step()
             return self.heal_and_check()
+        except AssertionError:
+            self.write_postmortem()
+            raise
         finally:
             self.close()
 
@@ -1123,9 +1149,12 @@ def main(argv=None) -> int:
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--seeds", type=int, default=2)
     ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--postmortem-dir", default=".",
+                    help="where postmortem-<seed>.tar.gz lands on failure")
     args = ap.parse_args(argv)
     for seed in range(args.seeds):
-        runner = CrashSoakRunner(n=args.replicas, seed=seed)
+        runner = CrashSoakRunner(n=args.replicas, seed=seed,
+                                 postmortem_dir=args.postmortem_dir)
         print(f"seed {seed}: {runner.run(args.steps)}")
     return 0
 

@@ -47,8 +47,9 @@ from crdt_tpu.faults import (
     plant_corruption,
 )
 from crdt_tpu.harness.crashsoak import RID_STRIDE, _free_ports
-from crdt_tpu.obs import health
+from crdt_tpu.obs import assemble, health
 from crdt_tpu.obs.events import read_jsonl
+from crdt_tpu.obs.provenance import BirthLedger, propagation_summary
 from crdt_tpu.utils.config import ClusterConfig
 
 
@@ -71,11 +72,24 @@ class NemesisReport:
     payload_quarantines: int = 0
     snapshot_quarantines: int = 0
     final_keys: int = 0
+    propagation: Dict[str, float] = dataclasses.field(default_factory=dict)
+    blame_coverage: Optional[float] = None
 
     def summary(self) -> str:
         faults = ", ".join(
             f"{k}={v}" for k, v in sorted(self.fault_counts.items())
         )
+        prop = ""
+        if self.propagation:
+            prop = (
+                f"; propagation p50/p99 = "
+                f"{self.propagation.get('propagation_steps_p50')}/"
+                f"{self.propagation.get('propagation_steps_p99')} steps "
+                f"over {self.propagation.get('propagation_steps_count')} "
+                f"visibilities"
+            )
+        if self.blame_coverage is not None:
+            prop += f"; blame coverage {self.blame_coverage:.3f}"
         return (
             f"seed {self.seed}: {self.steps} steps x {self.nodes} nodes — "
             f"{self.writes} writes, {self.pulls} pulls ({self.merges} "
@@ -86,6 +100,7 @@ class NemesisReport:
             f"{self.payload_quarantines} payload / "
             f"{self.snapshot_quarantines} snapshot; converged in "
             f"{self.heal_rounds} heal rounds to {self.final_keys} keys"
+            f"{prop}"
         )
 
 
@@ -123,17 +138,22 @@ class _Slot:
         inc = ckpt.bump_incarnation(self.ckpt_dir)
         rid = self.slot + RID_STRIDE * inc
         self.boots += 1
+        plane = self.soak.plane
         self.host = NodeHost(
             rid=rid, peers=self.peer_urls, port=self.port,
             config=self.soak.config, coordinator=(self.slot == 0),
             checkpoint_dir=self.ckpt_dir,
             event_log=self.event_log_path,
+            # flight recorder time base: the plane's step IS the soak's
+            # deterministic clock, and the ledger is fleet-shared, so
+            # propagation-steps lag lines up exactly with the fault log
+            step_clock=lambda: int(plane.step),
+            birth_ledger=self.soak.ledger,
         )
         # swap the agent's peer clients for fault-plane shims: every wire
         # interaction of the runtime under test now crosses the nemesis.
         # Breakers run on the plane's STEP clock and per-edge seeded
         # jitter so backoff windows replay identically under one seed.
-        plane = self.soak.plane
         self.transports = {
             j: FaultyTransport(
                 url, plane, src=str(self.slot), dst=str(j),
@@ -161,14 +181,21 @@ class _Slot:
 
 class NemesisSoak:
     def __init__(self, seed: int, nodes: int = 3, steps: int = 120,
-                 fault_log: Optional[str] = None):
+                 fault_log: Optional[str] = None,
+                 postmortem_dir: Optional[str] = None,
+                 assemble_check: bool = False):
         assert nodes >= 2, "nemesis needs a fleet (>= 2 nodes)"
         self.seed = seed
         self.steps = steps
+        self.postmortem_dir = postmortem_dir
+        self.assemble_check = assemble_check
         self._tmp = tempfile.TemporaryDirectory(prefix="nemesis_soak_")
         self.root = self._tmp.name
         self.schedule = NemesisSchedule.generate(seed, nodes, steps)
         self.plane = FaultPlane(self.schedule, log_path=fault_log)
+        # fleet-shared birth ledger: every slot's flight recorder converts
+        # newly-visible seqs to step lags against it (obs/provenance)
+        self.ledger = BirthLedger()
         self.config = ClusterConfig(
             n_replicas=nodes, seed=seed,
             gossip_period_ms=600_000,  # external drive only (determinism)
@@ -441,7 +468,36 @@ class NemesisSoak:
         self._check_idempotence()
         self._check_quarantine_provenance()
         self.report.fault_counts = self.plane.counts()
+        self.report.propagation = propagation_summary(
+            *(s.host.node.metrics.registry for s in self.slots)
+        )
+        if self.assemble_check:
+            self._check_assembly()
         return self.report
+
+    def _check_assembly(self, min_coverage: float = 0.95) -> None:
+        """The flight-recorder CI gate: assemble the fleet's JSONL logs
+        into one Perfetto timeline and require the blame report to explain
+        >= min_coverage of the convergence-lag spikes from the applied
+        fault log (ISSUE: op-level propagation tracing must be actionable,
+        not just pretty)."""
+        records = assemble.load_node_logs(
+            [s.event_log_path for s in self.slots])
+        assert records, "no node events were logged; recorder dead?"
+        trace = assemble.assemble_trace(records, fault_records=self.plane.log)
+        events = trace.get("traceEvents", [])
+        assert events, "assembled Perfetto trace is empty"
+        assert any(e.get("ph") == "X" for e in events), (
+            "assembled trace has no gossip-round spans"
+        )
+        blame = assemble.blame_report(records, self.plane.log)
+        self.report.blame_coverage = blame["coverage"]
+        assert blame["coverage"] >= min_coverage, (
+            f"blame report explains only {blame['coverage']:.3f} of "
+            f"{blame['n_spikes']} lag spikes (< {min_coverage}); "
+            f"unexplained: "
+            f"{[s for s in blame['spikes'] if s['cause'] == 'unexplained'][:3]}"
+        )
 
     def close(self) -> None:
         for s in self.slots:
@@ -450,19 +506,45 @@ class NemesisSoak:
         self.plane.close()
         self._tmp.cleanup()
 
+    def write_postmortem(self) -> Optional[str]:
+        """Bundle every node's JSONL black box + the applied-fault log +
+        the assembled trace + blame report into postmortem-<seed>.tar.gz
+        (uploaded as a CI artifact on failure).  Must run BEFORE close():
+        the event logs live in the soak's temp dir."""
+        if self.postmortem_dir is None:
+            return None
+        out = str(pathlib.Path(self.postmortem_dir)
+                  / f"postmortem-{self.seed}.tar.gz")
+        try:
+            assemble.write_postmortem(
+                out, [s.event_log_path for s in self.slots],
+                fault_records=self.plane.log,
+            )
+        except OSError as e:
+            print(f"[nemesis] postmortem bundling failed: {e}")
+            return None
+        print(f"[nemesis] postmortem bundle: {out}")
+        return out
+
     def run(self) -> NemesisReport:
         try:
             for i in range(self.steps):
                 self.step(i)
             return self.heal_and_check()
+        except AssertionError:
+            self.write_postmortem()
+            raise
         finally:
             self.close()
 
 
 def run_soak(seed: int, nodes: int, steps: int,
-             fault_log: Optional[str] = None) -> NemesisReport:
+             fault_log: Optional[str] = None,
+             postmortem_dir: Optional[str] = None,
+             assemble_check: bool = False) -> NemesisReport:
     return NemesisSoak(seed, nodes=nodes, steps=steps,
-                       fault_log=fault_log).run()
+                       fault_log=fault_log, postmortem_dir=postmortem_dir,
+                       assemble_check=assemble_check).run()
 
 
 def main(argv=None) -> int:
@@ -479,6 +561,12 @@ def main(argv=None) -> int:
     ap.add_argument("--replay-check", action="store_true",
                     help="run each seed twice and require byte-identical "
                          "fault logs (the determinism contract)")
+    ap.add_argument("--assemble-check", action="store_true",
+                    help="assemble the fleet's flight-recorder logs and "
+                         "require the blame report to explain >= 95%% of "
+                         "convergence-lag spikes")
+    ap.add_argument("--postmortem-dir", default=".",
+                    help="where postmortem-<seed>.tar.gz lands on failure")
     args = ap.parse_args(argv)
     for k in range(args.seeds):
         seed = args.seed_base + k
@@ -486,8 +574,11 @@ def main(argv=None) -> int:
             with tempfile.TemporaryDirectory(prefix="nemesis_replay_") as d:
                 log_a = str(pathlib.Path(d) / "a.jsonl")
                 log_b = str(pathlib.Path(d) / "b.jsonl")
-                rep = run_soak(seed, args.nodes, args.steps, fault_log=log_a)
-                run_soak(seed, args.nodes, args.steps, fault_log=log_b)
+                rep = run_soak(seed, args.nodes, args.steps, fault_log=log_a,
+                               postmortem_dir=args.postmortem_dir,
+                               assemble_check=args.assemble_check)
+                run_soak(seed, args.nodes, args.steps, fault_log=log_b,
+                         postmortem_dir=args.postmortem_dir)
                 a = pathlib.Path(log_a).read_bytes()
                 b = pathlib.Path(log_b).read_bytes()
                 assert a == b, (
@@ -497,7 +588,9 @@ def main(argv=None) -> int:
                 print(f"[nemesis] replay-check OK: {rep.summary()}")
         else:
             rep = run_soak(seed, args.nodes, args.steps,
-                           fault_log=args.fault_log)
+                           fault_log=args.fault_log,
+                           postmortem_dir=args.postmortem_dir,
+                           assemble_check=args.assemble_check)
             print(f"[nemesis] {rep.summary()}")
     return 0
 

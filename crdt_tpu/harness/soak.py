@@ -30,6 +30,7 @@ import sys
 from typing import Dict, List, Optional
 
 from crdt_tpu.api.cluster import LocalCluster
+from crdt_tpu.obs.provenance import BirthLedger, propagation_summary
 from crdt_tpu.oracle.replica import OracleReplica
 from crdt_tpu.utils.config import ClusterConfig
 
@@ -106,6 +107,14 @@ class SoakRunner:
             else len(self.cluster.nodes) - 1
         )
         self.report = SoakReport.zero()
+        # convergence flight recorder (crdt_tpu.obs.provenance): one
+        # fleet-shared birth ledger + the report's step counter as the
+        # deterministic time base -> live propagation-steps histograms
+        self.ledger = BirthLedger()
+        for node in self.cluster.nodes:
+            node.recorder.install(ledger=self.ledger,
+                                  step_clock=lambda: self.report.steps)
+            node.events.step_clock = lambda: self.report.steps
 
     # ---- schedule actions ----
 
@@ -264,6 +273,12 @@ class NetworkSoakRunner:
         self.p = (p_write, p_gossip, p_kill, p_revive, p_compact)
         self.keys = [f"k{i}" for i in range(n_keys)]
         self.report = SoakReport.zero()
+        # flight recorder: shared ledger + report-step clock (as in
+        # SoakRunner; the hosts are in-process so the ledger reaches all)
+        self.ledger = BirthLedger()
+        for h in self.hosts:
+            h.install_flight_recorder(
+                ledger=self.ledger, step_clock=lambda: self.report.steps)
 
     def close(self) -> None:
         for h in self.hosts:
@@ -401,6 +416,17 @@ def main(argv=None) -> int:
             "seed": seed, "steps": report.steps,
             "metrics": {k: round(v, 4) for k, v in report.metrics.items()},
         }, sort_keys=True))
+        # flight-recorder rollup: measured (not EWMA-estimated) op
+        # propagation lag across every origin->observer edge
+        if args.network:
+            prop = propagation_summary(
+                *(h.node.metrics.registry for h in runner.hosts))
+        else:
+            prop = propagation_summary(
+                runner.cluster.nodes[0].metrics.registry)
+        if prop:
+            print(json.dumps({"seed": seed, "propagation": prop},
+                             sort_keys=True))
     return 0
 
 

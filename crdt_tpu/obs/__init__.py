@@ -7,7 +7,18 @@ The host-facing ``Metrics`` class in crdt_tpu.utils.metrics is a thin
 shim over a ``MetricsRegistry``; every node surface (api/http_shim)
 serves ``GET /metrics`` in Prometheus text format.
 """
-from crdt_tpu.obs.events import EventLog, read_jsonl
+from crdt_tpu.obs.assemble import (
+    assemble_trace,
+    blame_report,
+    load_node_logs,
+    write_postmortem,
+)
+from crdt_tpu.obs.events import SCHEMA_VERSION, EventLog, read_jsonl
+from crdt_tpu.obs.provenance import (
+    BirthLedger,
+    FlightRecorder,
+    propagation_summary,
+)
 from crdt_tpu.obs.registry import (
     NULL_REGISTRY,
     Histogram,
@@ -18,6 +29,7 @@ from crdt_tpu.obs.trace import TRACE_HEADER, current_trace, mint_trace_id, span
 
 __all__ = [
     "EventLog",
+    "SCHEMA_VERSION",
     "read_jsonl",
     "Histogram",
     "MetricsRegistry",
@@ -27,4 +39,11 @@ __all__ = [
     "current_trace",
     "mint_trace_id",
     "span",
+    "BirthLedger",
+    "FlightRecorder",
+    "propagation_summary",
+    "assemble_trace",
+    "blame_report",
+    "load_node_logs",
+    "write_postmortem",
 ]

@@ -1,0 +1,24 @@
+"""CLI entry: ``python -m crdt_tpu.obs assemble <logs...>``."""
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m crdt_tpu.obs assemble <node.jsonl ...> "
+              "[--fault-log F] [--out trace.json] [--blame blame.json] "
+              "[--min-coverage 0.95]")
+        return 0 if argv else 2
+    cmd = argv.pop(0)
+    if cmd != "assemble":
+        print(f"unknown subcommand {cmd!r} (only: assemble)")
+        return 2
+    from crdt_tpu.obs.assemble import main as assemble_main
+
+    return assemble_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,15 @@ Events are kept in a bounded in-memory ring (cheap, always on) and,
 when a path is configured, appended to a JSONL file with a flush per
 line (crash-durability beats batching here; event rate is per-round, not
 per-op).
+
+Every line is stamped with the event SCHEMA VERSION (``"v"``) so offline
+consumers (crdt_tpu.obs.assemble, postmortem tooling) can tell what a
+record promises.  v1 (PR 1, unstamped) = {ts_ms, node, event, trace?,
+free-form fields}; v2 adds the explicit stamp, the optional driver-step
+field (``step``, present when a step clock is installed — the soak
+harnesses' deterministic time base), and the op-provenance events
+``op_birth`` / ``op_visible`` (crdt_tpu.obs.provenance).  See
+crdt_tpu/obs/README.md for the full schema.
 """
 from __future__ import annotations
 
@@ -17,16 +26,32 @@ import collections
 import json
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+# stamped into every JSONL line as "v"; bump on any field-meaning change
+SCHEMA_VERSION = 2
 
 
 class EventLog:
-    """Thread-safe bounded event ring with an optional JSONL file sink."""
+    """Thread-safe bounded event ring with an optional JSONL file sink.
+
+    ``step_clock`` (optional) stamps the driver's logical step into every
+    record — the deterministic time base that lets the offline assembler
+    align node events with the step-indexed applied-fault log.
+    ``registry`` (optional) receives the ring-eviction counter
+    (``crdt_events_dropped_total``), so a post-mortem can tell a quiet
+    node from a truncated ring.
+    """
 
     def __init__(self, node: str = "?", path: Optional[str] = None,
-                 capacity: int = 4096):
+                 capacity: int = 4096,
+                 step_clock: Optional[Callable[[], int]] = None,
+                 registry=None):
         self.node = str(node)
         self.path = path
+        self.step_clock = step_clock
+        self.registry = registry
+        self.dropped = 0  # ring evictions (file sink never drops)
         self._lock = threading.Lock()
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._fh = open(path, "a", encoding="utf-8") if path else None
@@ -34,14 +59,24 @@ class EventLog:
     def emit(self, event: str, trace: Optional[str] = None,
              **fields: Any) -> Dict[str, Any]:
         rec: Dict[str, Any] = {
+            "v": SCHEMA_VERSION,
             "ts_ms": int(time.time() * 1000),
             "node": self.node,
             "event": event,
         }
+        if self.step_clock is not None:
+            rec["step"] = int(self.step_clock())
         if trace is not None:
             rec["trace"] = trace
         rec.update(fields)
         with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                # the deque is about to evict its oldest record: count it,
+                # loudly — a silent eviction is indistinguishable from a
+                # quiet node in a post-mortem
+                self.dropped += 1
+                if self.registry is not None:
+                    self.registry.inc("events_dropped", node=self.node)
             self._ring.append(rec)
             if self._fh is not None:
                 self._fh.write(json.dumps(rec, sort_keys=True) + "\n")

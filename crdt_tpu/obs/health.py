@@ -94,6 +94,10 @@ def sample_kv_node(registry, node) -> None:
     registry.set_gauge("commands_retained", len(node._commands), node=lab)
     registry.set_gauge("summary_keys", len(node._summary), node=lab)
     registry.set_gauge("node_alive", int(node.alive), node=lab)
+    # ring evictions so far (the counter crdt_events_dropped_total is
+    # inc'd at eviction time; this gauge makes the total visible even in
+    # snapshots taken before the registry was attached to the log)
+    registry.set_gauge("events_ring_dropped", node.events.dropped, node=lab)
     last = registry.gauge_value("last_merge_unixtime", node=lab)
     if last is not None:
         registry.set_gauge("seconds_since_last_merge",

@@ -1,0 +1,204 @@
+"""Op-level propagation provenance: the convergence flight recorder's
+write-side and merge-side hooks.
+
+Every local write is stamped with a birth record ``(origin, seq,
+birth_step)``; every merge derives which origin-sequence ranges the round
+made NEWLY visible from the version-vector delta alone — the vv is
+monotone per writer, so the ranges ``(vv_before[origin], vv_after[origin]]``
+of successive rounds are disjoint, and a duplicated or reordered delivery
+(which teaches the node nothing, so its vv does not move) emits nothing.
+Exactly-once per (origin, seq, observer) therefore holds STRUCTURALLY,
+with no per-op dedup table and no per-op scan on device (the vv itself is
+a device reduction the node already maintains).
+
+Two latency spaces are recorded per origin→observer edge:
+
+* ``crdt_op_propagation_steps``   — soak-step lag, when the driver installs
+  a shared :class:`BirthLedger` + step clock (the nemesis/soak harnesses
+  do; steps are the deterministic time base of the fault plane, so blame
+  windows line up exactly);
+* ``crdt_op_propagation_seconds`` — true end-to-end wall lag, derived from
+  the op's WIRE timestamp (absolute Unix ms — the key format already
+  carries it, so this works across processes with no wire change).
+
+"Linearizable State Machine Replication of State-Based CRDTs without
+Logs" (PAPERS.md) motivates tracking per-op visibility frontiers;
+"Approaches to Conflict-free Replicated Data Types" frames convergence
+lag as THE eventual-consistency quality metric — this module measures it
+directly instead of estimating it via the PR 1 EWMA gauge.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from crdt_tpu.obs.trace import current_trace
+
+
+class BirthLedger:
+    """In-process shared map ``(origin rid, seq) -> birth step``.
+
+    One ledger is shared by every replica a driver hosts (the soak
+    harnesses install it fleet-wide), so an observer can convert a
+    newly-visible seq into a step lag without any wire traffic.  Seqs are
+    per-writer contiguous from 0 (crdt_tpu.utils.clock.SeqGen), so the
+    store is a per-origin list indexed by seq — O(1) lookups, O(ops)
+    memory.  Cross-process fleets have no shared ledger; there the steps
+    histogram is assembled OFFLINE from the ``op_birth``/``op_visible``
+    events (crdt_tpu.obs.assemble) and only the seconds histogram is live.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._steps: Dict[int, List[int]] = {}
+
+    def note(self, origin: int, seq: int, step: int) -> None:
+        with self._lock:
+            steps = self._steps.setdefault(int(origin), [])
+            if seq == len(steps):
+                steps.append(int(step))
+            elif seq < len(steps):
+                steps[seq] = int(step)
+            else:
+                # a hole means the caller skipped seqs (not contiguous —
+                # only possible if SeqGen semantics change); backfill with
+                # this step so later lookups stay conservative (lag >= 0)
+                steps.extend([int(step)] * (seq - len(steps) + 1))
+
+    def birth_step(self, origin: int, seq: int) -> Optional[int]:
+        with self._lock:
+            steps = self._steps.get(int(origin))
+            if steps is None or not (0 <= seq < len(steps)):
+                return None
+            return steps[seq]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._steps.values())
+
+
+class FlightRecorder:
+    """Per-replica recorder: birth stamps on the write path, vv-delta
+    visibility on the merge path.
+
+    Enablement rides the node's metrics registry (``registry.enabled``),
+    so the NULL_REGISTRY arm of benches/bench_obs_overhead.py measures
+    the recorder off for free, and a perf-critical embedding that opts
+    out of metrics opts out of provenance with the same switch.
+    """
+
+    def __init__(self, rid: int, registry, events=None):
+        self.rid = int(rid)
+        self.node_label = str(rid)
+        self.registry = registry
+        self.events = events
+        self.ledger: Optional[BirthLedger] = None
+        self.step_clock: Optional[Callable[[], int]] = None
+
+    @property
+    def enabled(self) -> bool:
+        return bool(getattr(self.registry, "enabled", False))
+
+    def install(self, ledger: Optional[BirthLedger] = None,
+                step_clock: Optional[Callable[[], int]] = None) -> None:
+        """Attach the driver's shared ledger / step clock (soak harnesses).
+        Either may be omitted; installing neither leaves the recorder in
+        wall-clock-only mode (the cross-process deployment default)."""
+        if ledger is not None:
+            self.ledger = ledger
+        if step_clock is not None:
+            self.step_clock = step_clock
+
+    def _now_step(self) -> Optional[int]:
+        return int(self.step_clock()) if self.step_clock is not None else None
+
+    # ---- write side ----
+
+    def note_birth(self, seq: int, op_ts_ms: int) -> None:
+        """Stamp one local write: ``(origin=self.rid, seq, birth_step)``
+        into the shared ledger (when installed) and an ``op_birth`` event
+        into the node's black box.  ``op_ts_ms`` is the op's WIRE
+        timestamp (absolute Unix ms) — the identity every observer sees,
+        so the offline assembler can join births to visibilities without
+        the ledger."""
+        step = self._now_step()
+        if self.ledger is not None and step is not None:
+            self.ledger.note(self.rid, seq, step)
+        if self.events is not None:
+            self.events.emit("op_birth", origin=self.rid, seq=seq,
+                             op_ts_ms=int(op_ts_ms))
+
+    # ---- merge side ----
+
+    def note_visible(self, vv_before: Dict[int, int],
+                     vv_after: Dict[int, int],
+                     births: Optional[Dict[Tuple[int, int], int]] = None,
+                     trace: Optional[str] = None) -> int:
+        """Derive the newly-visible origin-seq ranges from the vv delta of
+        one merge and record them: one ``op_visible`` event per origin
+        range, one histogram observation per (origin, seq).
+
+        ``births`` maps ``(origin, seq) -> wire ts (absolute ms)`` for the
+        ops that arrived as raw rows this round (seqs that became visible
+        through a compaction-frontier adoption have no row; they get the
+        event and the step lag but no seconds observation).  Returns the
+        number of newly-visible ops."""
+        now_ms = int(time.time() * 1000)
+        step = self._now_step()
+        tid = trace if trace is not None else current_trace()
+        total = 0
+        for origin in sorted(vv_after):
+            hi = vv_after[origin]
+            lo = vv_before.get(origin, -1)
+            if hi <= lo or origin < 0 or origin == self.rid:
+                # no progress / watermarkless Go-format ops / own writes
+                # (local visibility is birth, not propagation)
+                continue
+            olab = str(origin)
+            max_lag: Optional[int] = None
+            for seq in range(lo + 1, hi + 1):
+                if births is not None:
+                    born = births.get((origin, seq))
+                    if born is not None:
+                        self.registry.observe(
+                            "op_propagation",
+                            max(0.0, (now_ms - born) / 1e3),
+                            origin=olab, node=self.node_label,
+                        )
+                if step is not None and self.ledger is not None:
+                    bstep = self.ledger.birth_step(origin, seq)
+                    if bstep is not None:
+                        lag = max(0, step - bstep)
+                        self.registry.observe(
+                            "op_propagation_steps", float(lag),
+                            origin=olab, node=self.node_label,
+                        )
+                        max_lag = lag if max_lag is None else max(max_lag, lag)
+            total += hi - lo
+            if self.events is not None:
+                self.events.emit("op_visible", trace=tid, origin=origin,
+                                 seq_lo=lo + 1, seq_hi=hi, n=hi - lo,
+                                 lag_steps=max_lag)
+        return total
+
+
+def propagation_summary(*registries) -> Dict[str, float]:
+    """Fleet-wide rollup of the propagation histograms (all origin→observer
+    edges of every given registry merged — histogram merge is elementwise
+    add, so the fold is order-free).  Used by the soak reports."""
+    out: Dict[str, float] = {}
+    for name, unit in (("op_propagation_steps", "steps"),
+                       ("op_propagation", "s")):
+        series = []
+        for registry in registries:
+            series.extend(registry.histograms(name))
+        if not series:
+            continue
+        merged = series[0][1]
+        for _, h in series[1:]:
+            merged = merged.merge(h)
+        out[f"propagation_{unit}_count"] = merged.count
+        out[f"propagation_{unit}_p50"] = round(merged.quantile(0.5), 6)
+        out[f"propagation_{unit}_p99"] = round(merged.quantile(0.99), 6)
+    return out

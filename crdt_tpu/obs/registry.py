@@ -73,10 +73,13 @@ class Histogram:
         return out
 
     def quantile(self, q: float) -> float:
-        """Bucket-upper-bound estimate of the q-quantile (NaN when empty)."""
+        """Bucket-upper-bound estimate of the q-quantile (NaN when empty).
+        ``q`` is clamped into the observed mass: q<=0 lands on the first
+        occupied bucket, q>=1 on the last — so q=1 reports the max's
+        bucket bound instead of falling through to +Inf."""
         if self.count == 0:
             return float("nan")
-        rank = max(1, math.ceil(q * self.count))
+        rank = min(self.count, max(1, math.ceil(q * self.count)))
         cum = 0
         for i, b in enumerate(self.buckets):
             cum += b
@@ -178,6 +181,16 @@ class MetricsRegistry:
             h = self._hists.get((name, _labels_key(labels)))
             return h.copy() if h is not None else None
 
+    def histograms(self, name: str) -> List[Tuple[Dict[str, str], Histogram]]:
+        """Every labeled series of one histogram name, as (labels, copy)
+        pairs — callers fold them with Histogram.merge (fleet rollups)."""
+        with self._lock:
+            return [
+                (dict(k[1]), h.copy())
+                for k, h in self._hists.items()
+                if k[0] == name
+            ]
+
     def _run_callbacks(self) -> None:
         # outside the lock: callbacks call set_gauge themselves
         with self._lock:
@@ -232,7 +245,12 @@ class MetricsRegistry:
             emit_type(full, "gauge")
             lines.append(f"{full}{_render_labels(labels)} {_num(v)}")
         for (name, labels), h in hists:
-            full = f"{ns}_{sanitize_name(name)}_seconds"
+            # the implicit unit is seconds; a name that already carries
+            # its own unit (op_propagation_steps) is left alone so the
+            # exposition doesn't read "steps_seconds"
+            full = f"{ns}_{sanitize_name(name)}"
+            if not name.endswith("_steps"):
+                full += "_seconds"
             emit_type(full, "histogram")
             cum = 0
             for i, b in enumerate(h.buckets):

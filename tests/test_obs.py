@@ -85,6 +85,32 @@ def test_histogram_quantile():
     assert 0.010 <= q <= 0.020
 
 
+def test_histogram_quantile_edges():
+    import math
+
+    h = Histogram()
+    # empty: every q is NaN, including the edges
+    for q in (0.0, 0.5, 1.0, -1.0, 2.0):
+        assert math.isnan(h.quantile(q))
+    h.observe(0.010)
+    h.observe(100.0)
+    lo, hi = h.quantile(0.0), h.quantile(1.0)
+    # q<=0 clamps to the first occupied bucket, q>=1 to the last — both
+    # finite (q=1 used to fall through to +Inf on ceil(1*count) == count
+    # landing in the +Inf cumulative check)
+    assert 0.010 <= lo <= 0.020
+    assert 100.0 <= hi <= 256.0 and math.isfinite(hi)
+    assert h.quantile(-0.5) == lo and h.quantile(2.0) == hi
+    # single observation: every q names its bucket
+    h1 = Histogram()
+    h1.observe(0.5)
+    assert h1.quantile(0.0) == h1.quantile(0.5) == h1.quantile(1.0) == 0.5
+    # an overflow (+Inf bucket) observation keeps q=1 at +Inf honestly
+    h2 = Histogram()
+    h2.observe(2.0 ** 11)
+    assert h2.quantile(1.0) == float("inf")
+
+
 # ----------------------------------------------------------------- registry
 
 
