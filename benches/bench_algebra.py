@@ -1,0 +1,225 @@
+"""A/B: algebra-composed ``mapof(pncounter)`` vs the bespoke ormap join.
+
+The compositional algebra (crdt_tpu.ops.algebra) derives its keyed-map
+lattice by slotting a vmapped inner join into the existing ormap
+presence machinery — so the composed join should cost exactly what the
+hand-written ``ormap.join(a, b, vmap(pncounter.join))`` costs, and both
+must produce bit-identical states.  This bench pins that claim at bench
+shapes: any composed-arm slowdown beyond noise means the combinator
+layer added dispatches or materialized intermediates it shouldn't have.
+
+Methodology (house rules, benches/bench_baseline.py): both arms run as
+INTERLEAVED adjacent pairs with alternating order over the SAME seeded
+replica states, medians reported, every rep's outputs checked bit-equal
+(the parity tests/test_algebra.py pins at small shapes, here at bench
+shapes).  Each arm drives the PR 2 striped runtime
+(crdt_tpu.parallel.pipeline.run_striped): one stripe = host-staging R
+random replica states + ONE jitted log-depth fold dispatch, so the
+``device_dispatches`` accounting shows the composed join rides the
+fused path with zero extra dispatches per round.
+
+Usage:
+  python benches/bench_algebra.py                # default shape
+  python benches/bench_algebra.py --tiny --cpu   # CI smoke
+  python benches/bench_algebra.py --keys 256 --writers 16
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import statistics
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from crdt_tpu.obs.registry import MetricsRegistry  # noqa: E402
+
+OBS = MetricsRegistry()
+
+# replicas folded per stripe (pow2: the fold halves without padding)
+REPLICAS = 8
+
+_FOLD_CACHE: dict = {}  # (arm, n_replicas) -> jitted fold
+
+
+def _fold_fn(arm: str):
+    """One jitted log-depth fold per arm, shared by all reps (jax re-traces
+    per state shape, exactly like the serving path's fold cache)."""
+    import jax
+
+    key = (arm, REPLICAS)
+    if key not in _FOLD_CACHE:
+        if arm == "composed":
+            from crdt_tpu.ops.joins import registered_joins
+
+            join = registered_joins()["mapof(pncounter)"].join
+        else:
+            from crdt_tpu.models import ormap, pncounter
+
+            join = ormap.joiner(jax.vmap(pncounter.join))
+        vjoin = jax.vmap(join)
+
+        @jax.jit
+        def fold(stacked):
+            state = stacked
+            p = REPLICAS
+            while p > 1:
+                p //= 2
+                lo = jax.tree.map(lambda x: x[:p], state)
+                hi = jax.tree.map(lambda x: x[p:2 * p], state)
+                state = vjoin(lo, hi)
+            return jax.tree.map(lambda x: x[0], state)
+
+        _FOLD_CACHE[key] = fold
+    return _FOLD_CACHE[key]
+
+
+def _stage_states(rng, n_keys, n_writers):
+    """Host-stage R random reachable mapof(pncounter) replica states
+    (leading axis = replica), like decoded gossip payloads would."""
+    r, k, w = REPLICAS, n_keys, n_writers
+    return {
+        "tok": rng.integers(-1, 6, (r, k, w)).astype(np.int32),
+        "obs": rng.integers(-1, 6, (r, k, w, w)).astype(np.int32),
+        "pos": rng.integers(0, 100, (r, k, w)).astype(np.int32),
+        "neg": rng.integers(0, 100, (r, k, w)).astype(np.int32),
+    }
+
+
+def _to_ormap(planes):
+    import jax.numpy as jnp
+
+    from crdt_tpu.models import flags, ormap, pncounter
+
+    return ormap.ORMap(
+        presence=flags.TokenPlane(tok=jnp.asarray(planes["tok"]),
+                                  obs=jnp.asarray(planes["obs"])),
+        values=pncounter.PNCounter(pos=jnp.asarray(planes["pos"]),
+                                   neg=jnp.asarray(planes["neg"])),
+    )
+
+
+def _stripe_driver(arm, stripes, n_keys, n_writers, seed, registry=None):
+    """Run one striped fold pass; returns (results, stats, wall_s).  Per
+    stripe: build() host-stages R replica states, dispatch() issues ONE
+    jitted fold.  A fresh seeded Generator makes the stripe sequence a
+    pure function of ``seed`` so both arms consume identical operands."""
+    import jax
+
+    from crdt_tpu.parallel import pipeline
+
+    fold = _fold_fn(arm)
+    rng = np.random.default_rng(seed)
+
+    def build(i):
+        return (jax.device_put(_to_ormap(_stage_states(rng, n_keys,
+                                                       n_writers))),)
+
+    def dispatch(i, stacked):
+        return fold(stacked)
+
+    t0 = time.perf_counter()
+    results, stats = pipeline.run_striped(
+        stripes, build, dispatch, pipelined=True, registry=registry,
+        pipeline=f"algebra_{arm}",
+    )
+    return results, stats, time.perf_counter() - t0
+
+
+def _outputs_equal(ra, rb):
+    import jax
+
+    return all(
+        np.array_equal(np.asarray(la), np.asarray(lb))
+        for a, b in zip(ra, rb)
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _ab_config(stripes, n_keys, n_writers, reps):
+    """One interleaved adjacent-pair A/B at a fixed shape; returns a row."""
+    import jax
+
+    for arm in ("composed", "bespoke"):  # compile + warm both folds
+        _stripe_driver(arm, 2, n_keys, n_writers, 0)
+    composed_t, bespoke_t = [], []
+    for rep in range(reps):
+        seed = 100 + rep
+        # alternate arm order per rep: drift cancels in the medians
+        if rep % 2 == 0:
+            rc, sc, wc = _stripe_driver("composed", stripes, n_keys,
+                                        n_writers, seed, registry=OBS)
+            rb, sb, wb = _stripe_driver("bespoke", stripes, n_keys,
+                                        n_writers, seed)
+        else:
+            rb, sb, wb = _stripe_driver("bespoke", stripes, n_keys,
+                                        n_writers, seed)
+            rc, sc, wc = _stripe_driver("composed", stripes, n_keys,
+                                        n_writers, seed, registry=OBS)
+        assert _outputs_equal(rc, rb), (
+            "composed mapof(pncounter) diverged from bespoke ormap join "
+            "(parity invariant, tests/test_algebra.py)")
+        assert sc["dispatches"] == sb["dispatches"] == stripes
+        composed_t.append(wc)
+        bespoke_t.append(wb)
+
+    med_c = statistics.median(composed_t)
+    med_b = statistics.median(bespoke_t)
+    # one fold = R-1 pairwise K x W map merges in log2(R) batched steps
+    cells = stripes * (REPLICAS - 1) * n_keys * n_writers
+    backend = jax.default_backend()
+    note = (f"{stripes} stripes x R={REPLICAS} replicas of K={n_keys} "
+            f"W={n_writers}, {reps} interleaved reps, backend={backend}; "
+            f"composed {med_c * 1e3:.1f} ms vs bespoke {med_b * 1e3:.1f} ms "
+            f"({med_c / cells * 1e9:.0f} ns/cell), outputs bit-equal, "
+            f"1 dispatch per fold both arms")
+    return {
+        "metric": f"algebra_composed_overhead_k{n_keys}_w{n_writers}",
+        "value": round(med_c / med_b, 3),
+        "unit": "x", "vs_baseline": 1.0, "note": note,
+        "composed_ms": round(med_c * 1e3, 2),
+        "bespoke_ms": round(med_b * 1e3, 2),
+        "ns_per_cell": round(med_c / cells * 1e9, 1),
+        "device_dispatches": stripes,
+        "backend": backend,
+    }
+
+
+def run_ab(tiny, stripes=None, keys=None, writers=None, reps=None):
+    """The measured A/B across two map shapes; returns result rows."""
+    stripes = stripes or (4 if tiny else 8)
+    reps = reps or (3 if tiny else 7)
+    shapes = ([(keys, writers)] if keys and writers
+              else [(16, 4)] if tiny else [(64, 8), (512, 16)])
+    return [_ab_config(stripes, k, w, reps) for k, w in shapes]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true", help="CI smoke shape")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--stripes", type=int, default=None)
+    ap.add_argument("--keys", type=int, default=None)
+    ap.add_argument("--writers", type=int, default=None)
+    ap.add_argument("--reps", type=int, default=None)
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    for line in run_ab(args.tiny, stripes=args.stripes, keys=args.keys,
+                       writers=args.writers, reps=args.reps):
+        print(json.dumps(line), flush=True)
+    print(json.dumps({
+        "metric": "obs_snapshot", "value": 1.0, "unit": "rows",
+        "note": "algebra pipeline registry snapshot",
+        "obs": {k: round(v, 6) for k, v in OBS.snapshot().items()},
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,7 @@ RULES = {
     "CRDT101": "registered join traces a callback primitive (impure jaxpr)",
     "CRDT102": "registered join is not aval-closed (out avals != self avals)",
     "CRDT103": "join claimed structurally commutative has asymmetric jaxpr",
+    "CRDT104": "composite claims structural commutativity its parts don't all claim",
     "CRDT201": "shared mutable state written from thread-reachable code without a lock",
 }
 
@@ -49,6 +50,7 @@ SEVERITY = {
     "CRDT101": SEV_ERROR,
     "CRDT102": SEV_ERROR,
     "CRDT103": SEV_ERROR,
+    "CRDT104": SEV_ERROR,
     "CRDT201": SEV_WARN,
 }
 

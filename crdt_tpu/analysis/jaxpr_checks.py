@@ -26,6 +26,15 @@ CRDT103 swap symmetry (only where claimed)
     single value.  (Select-based joins are extensionally commutative but
     not operand-symmetric — they claim False and are covered by
     tests/test_lattice_laws.py instead.)
+
+CRDT104 metadata propagation (composites only)
+    A composite (``spec.parts`` non-empty, built by crdt_tpu.ops.algebra)
+    registered ``structurally_commutative=True`` must have every part
+    registered with the same claim: the composed jaxpr inlines the part
+    joins, so an asymmetric part makes the composite's claim a lie the
+    moment canonicalization can't mask it.  Claim-True-over-claim-False
+    parts is always a registration bug even when CRDT103 happens to pass
+    on today's traced shapes.
 """
 from __future__ import annotations
 
@@ -118,6 +127,24 @@ def check_registered_joins(rel_base: pathlib.Path) -> List[Finding]:
             relpath = src_file.resolve().relative_to(rel_base).as_posix()
         except (TypeError, OSError, ValueError):
             relpath, line = "crdt_tpu/ops/joins.py", 1
+
+        # CRDT104: composite metadata propagation — a composite claiming
+        # structural commutativity needs every part to claim it too
+        parts = getattr(spec, "parts", ())
+        if parts and spec.structurally_commutative:
+            bad = [p for p in parts
+                   if p not in registry
+                   or not registry[p].structurally_commutative]
+            if bad:
+                findings.append(Finding(
+                    rule="CRDT104", path=relpath, line=line, scope=name,
+                    detail=f"{name}|parts-claim|{','.join(bad)}",
+                    message=(f"composite '{name}' claims structural "
+                             f"commutativity but part(s) "
+                             f"{', '.join(repr(p) for p in bad)} don't — "
+                             f"metadata must propagate as the AND of the "
+                             f"parts' claims"),
+                ))
 
         a, b = spec.example()
         try:

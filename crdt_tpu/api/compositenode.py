@@ -1,0 +1,435 @@
+"""CompositeNode: an algebra-derived lattice across the process boundary.
+
+The sibling lattices (set/seq/map nodes) each hand-build their wire, their
+merge, and their GC.  This node is the payoff of the compositional algebra
+(crdt_tpu.ops.algebra): it serves ``mapof(pncounter)`` — the ormap-of-
+counters composite REGISTERED by crdt_tpu.models.composite — and its merge
+is nothing but that registered join.  No bespoke merge code exists here:
+the join that crdtlint traces (CRDT101-104), the ACI law sweep checks, and
+the parity tests pin against bespoke ``ormap.join`` is byte-for-byte the
+one folding gossip payloads in production.
+
+Wire model — state-based, unlike the op-shipping siblings: a gossip
+payload is the full trimmed state dump (keys, writer rids, and the four
+OR-Map planes).  Join idempotence makes duplicated delivery a no-op and
+join monotonicity makes old-after-new a no-op, so the payload needs no
+version vector, no delta negotiation, and no floor/epoch machinery —
+the algebra's laws ARE the protocol.  The cost is payload size growing
+with the key/writer universe; the composite is meant for small maps
+(feature flags, quota counters), and the bench (benches/bench_algebra.py)
+keeps the trade-off measured.
+
+Dispatch discipline (the PR-2 fused-ingest rule): ``merge_decoded`` folds
+ANY number of decoded peer payloads plus the local state in ONE jitted
+device dispatch — a k-way fused pull round costs the composite exactly
+one dispatch, same as a single-peer pull (``merge_dispatches`` counts
+them; tests pin k payloads → +1).
+
+Alignment: peers intern keys and writers independently, so decoded
+payloads arrive in foreign coordinate spaces.  ``merge_decoded`` builds
+the union key/writer space host-side (numpy scatter into capacity-padded
+planes — the registered join is shape-generic, so growth is just a bigger
+trace), then stacks [own, peer1, ..., peerK, neutral-pad] and folds.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from crdt_tpu.utils.intern import Interner
+from crdt_tpu.utils.metrics import Metrics
+
+COMPOSITE_JOIN = "mapof(pncounter)"
+
+
+@dataclasses.dataclass
+class DecodedComposite:
+    """One validated peer payload in its own (foreign) coordinate space."""
+
+    keys: List[str]
+    writers: List[int]  # wire rids, column order
+    tok: np.ndarray     # int32[K, W]
+    obs: np.ndarray     # int32[K, W, W]
+    pos: np.ndarray     # int32[K, W]
+    neg: np.ndarray     # int32[K, W]
+
+
+# the jitted k-way fold of the REGISTERED composite join, built once (a
+# jit per gossip round would be crdtlint CRDT002's recompile trap); jax
+# itself caches compilations per stacked shape, and shapes only change on
+# capacity doubling
+_FOLD_CACHE: Dict[str, Any] = {}
+
+
+def _fold_fn():
+    fn = _FOLD_CACHE.get("fn")
+    if fn is None:
+        import jax
+
+        from crdt_tpu.ops import joins
+
+        spec = joins.registered_joins()[COMPOSITE_JOIN]
+        pairwise = jax.vmap(spec.join)
+
+        def fold(stacked):
+            # log-depth halving over the (pow2-padded) replica axis; the
+            # whole reduction traces into one program → one dispatch
+            p = stacked.presence.tok.shape[0]
+            while p > 1:
+                p //= 2
+                lo = jax.tree.map(lambda x: x[:p], stacked)
+                hi = jax.tree.map(lambda x: x[p:2 * p], stacked)
+                stacked = pairwise(lo, hi)
+            return jax.tree.map(lambda x: x[0], stacked)
+
+        fn = _FOLD_CACHE["fn"] = jax.jit(fold)
+    return fn
+
+
+def _plane(x: Any, shape: tuple, what: str) -> np.ndarray:
+    """Validate one wire plane into int32 of exactly ``shape`` (empty
+    lists are accepted for zero-sized planes)."""
+    try:
+        a = np.asarray(x, dtype=np.int32)
+    except Exception as e:
+        raise ValueError(f"composite payload plane {what!r} is not an "
+                         f"integer array: {e}") from None
+    if a.size == 0 and 0 in shape:
+        return a.reshape(shape)
+    if a.shape != shape:
+        raise ValueError(f"composite payload plane {what!r} has shape "
+                         f"{a.shape}, expected {shape}")
+    return a
+
+
+class CompositeNode:
+    """One replica of the served ``mapof(pncounter)`` composite.
+
+    Thread-safe like the sibling lattices (one lock over mutation, read,
+    and serve); numpy mirrors of the four OR-Map planes carry the state,
+    and every merge goes through the registry's composite join."""
+
+    def __init__(self, rid: int, n_keys: int = 8, n_writers: int = 8,
+                 metrics: Optional[Metrics] = None):
+        self.rid = rid
+        self.metrics = metrics or Metrics()
+        self.alive = True
+        self.keys = Interner()
+        self._lock = threading.Lock()
+        self._writers: List[int] = []           # column -> wire rid
+        self._wcol: Dict[int, int] = {}         # wire rid -> column
+        self._k = n_keys
+        self._w = n_writers
+        self._tok = np.full((n_keys, n_writers), -1, np.int32)
+        self._obs = np.full((n_keys, n_writers, n_writers), -1, np.int32)
+        self._pos = np.zeros((n_keys, n_writers), np.int32)
+        self._neg = np.zeros((n_keys, n_writers), np.int32)
+        self.merge_dispatches = 0
+
+    # ---- capacity / interning (all under self._lock) ----
+
+    def _grow_keys_locked(self, k_needed: int) -> None:
+        k2 = self._k
+        while k_needed > k2:
+            k2 *= 2
+        if k2 == self._k:
+            return
+        dk = k2 - self._k
+        self._tok = np.pad(self._tok, ((0, dk), (0, 0)), constant_values=-1)
+        self._obs = np.pad(self._obs, ((0, dk), (0, 0), (0, 0)),
+                           constant_values=-1)
+        self._pos = np.pad(self._pos, ((0, dk), (0, 0)))
+        self._neg = np.pad(self._neg, ((0, dk), (0, 0)))
+        self._k = k2
+
+    def _grow_writers_locked(self, w_needed: int) -> None:
+        w2 = self._w
+        while w_needed > w2:
+            w2 *= 2
+        if w2 == self._w:
+            return
+        dw = w2 - self._w
+        self._tok = np.pad(self._tok, ((0, 0), (0, dw)), constant_values=-1)
+        self._obs = np.pad(self._obs, ((0, 0), (0, dw), (0, dw)),
+                           constant_values=-1)
+        self._pos = np.pad(self._pos, ((0, 0), (0, dw)))
+        self._neg = np.pad(self._neg, ((0, 0), (0, dw)))
+        self._w = w2
+
+    def _kid_locked(self, key: str) -> int:
+        kid = self.keys.intern(key)
+        self._grow_keys_locked(len(self.keys))
+        return kid
+
+    def _wcol_locked(self, rid: int) -> int:
+        col = self._wcol.get(rid)
+        if col is None:
+            col = len(self._writers)
+            self._writers.append(int(rid))
+            self._wcol[int(rid)] = col
+            self._grow_writers_locked(len(self._writers))
+        return col
+
+    # ---- write path (local ops) ----
+
+    def upd(self, key: str, delta: int) -> Optional[int]:
+        """Apply a signed delta to ``key`` under this node's writer slot
+        (token drop + PN split — the composite's ormap.update/pncounter.add
+        pair, host-mirrored).  Returns the key's new value; None when
+        down."""
+        with self._lock:
+            if not self.alive:
+                return None
+            kid = self._kid_locked(str(key))
+            col = self._wcol_locked(self.rid)
+            self._tok[kid, col] = max(self._tok[kid, col], -1) + 1
+            d = int(delta)
+            if d >= 0:
+                self._pos[kid, col] += d
+            else:
+                self._neg[kid, col] += -d
+            self.metrics.inc("composite_ops")
+            return int(self._pos[kid].sum() - self._neg[kid].sum())
+
+    def rem(self, key: str) -> Optional[bool]:
+        """Observed-remove of ``key``: this node's observer row adopts the
+        token vector it has seen.  Returns whether a remove was minted
+        (False when the key is absent); None when down."""
+        with self._lock:
+            if not self.alive:
+                return None
+            k = str(key)
+            if k not in self.keys:
+                return False
+            kid = self.keys.intern(k)
+            if not self._contains_locked(kid):
+                return False
+            col = self._wcol_locked(self.rid)
+            self._obs[kid, col, :] = np.maximum(self._obs[kid, col, :],
+                                                self._tok[kid])
+            self.metrics.inc("composite_ops")
+            return True
+
+    # ---- read path ----
+
+    def _contains_locked(self, kid: int) -> bool:
+        tok = self._tok[kid]
+        seen = self._obs[kid].max(axis=0)
+        return bool(((tok >= 0) & (tok > seen)).any())
+
+    def value(self, key: str) -> Optional[int]:
+        if not self.alive:
+            return None
+        with self._lock:
+            k = str(key)
+            if k not in self.keys:
+                return None
+            kid = self.keys.intern(k)
+            if not self._contains_locked(kid):
+                return None
+            return int(self._pos[kid].sum() - self._neg[kid].sum())
+
+    def items(self) -> Optional[Dict[str, int]]:
+        """{key: value} over contained keys (None when down)."""
+        if not self.alive:
+            return None
+        with self._lock:
+            out = {}
+            for k, kid in self.keys.items():
+                if self._contains_locked(kid):
+                    out[k] = int(self._pos[kid].sum() - self._neg[kid].sum())
+            return out
+
+    def fingerprint(self) -> Dict[str, Any]:
+        """Canonical, intern-order-free rendering of the full state (keys
+        with any history, their per-writer planes keyed by wire rid) —
+        two replicas are converged iff their fingerprints are equal."""
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for k, kid in self.keys.items():
+                ent: Dict[str, Any] = {}
+                for col, rid in enumerate(self._writers):
+                    r = str(rid)
+                    if self._tok[kid, col] >= 0:
+                        ent.setdefault("tok", {})[r] = int(self._tok[kid, col])
+                    if self._pos[kid, col]:
+                        ent.setdefault("pos", {})[r] = int(self._pos[kid, col])
+                    if self._neg[kid, col]:
+                        ent.setdefault("neg", {})[r] = int(self._neg[kid, col])
+                    for col2, rid2 in enumerate(self._writers):
+                        if self._obs[kid, col, col2] >= 0:
+                            ent.setdefault("obs", {}).setdefault(r, {})[
+                                str(rid2)] = int(self._obs[kid, col, col2])
+                if ent:
+                    out[k] = ent
+            return out
+
+    def ping(self) -> bool:
+        return self.alive
+
+    def set_alive(self, alive: bool) -> None:
+        self.alive = bool(alive)
+
+    # ---- wire ----
+
+    def _dump_locked(self) -> Dict[str, Any]:
+        ks = [k for k, _ in sorted(self.keys.items(), key=lambda e: e[1])]
+        ku, wu = len(ks), len(self._writers)
+        return {
+            "keys": ks,
+            "writers": list(self._writers),
+            "tok": self._tok[:ku, :wu].tolist(),
+            "obs": self._obs[:ku, :wu, :wu].tolist(),
+            "pos": self._pos[:ku, :wu].tolist(),
+            "neg": self._neg[:ku, :wu].tolist(),
+        }
+
+    def gossip_payload(self) -> Optional[Dict[str, Any]]:
+        """GET /composite/gossip body: the full trimmed state dump (see
+        module docstring for why state-based needs no vv/delta); None when
+        down."""
+        if not self.alive:
+            return None
+        with self._lock:
+            return self._dump_locked()
+
+    @staticmethod
+    def decode(payload: Any) -> DecodedComposite:
+        """Validate one wire payload (pure: no lock, no state).  Raises
+        ValueError on anything malformed — the nemesis corruption marker,
+        poisoned sections, ragged or mis-shaped planes, duplicate keys or
+        writers — so NetworkAgent._receive_quarantined turns a corrupt
+        peer into a quarantine event instead of a dead loop."""
+        if not isinstance(payload, dict):
+            raise ValueError("composite payload is not a JSON object")
+        if "__nemesis_corrupt__" in payload:
+            raise ValueError("composite payload carries the nemesis "
+                             "corruption marker")
+        keys = payload.get("keys")
+        writers = payload.get("writers")
+        if (not isinstance(keys, list)
+                or not all(isinstance(k, str) for k in keys)):
+            raise ValueError("composite payload 'keys' is not a list of "
+                             "strings")
+        if (not isinstance(writers, list)
+                or not all(isinstance(w, int) and not isinstance(w, bool)
+                           for w in writers)):
+            raise ValueError("composite payload 'writers' is not a list of "
+                             "integer rids")
+        if len(set(keys)) != len(keys):
+            raise ValueError("composite payload has duplicate keys")
+        if len(set(writers)) != len(writers):
+            raise ValueError("composite payload has duplicate writers")
+        ku, wu = len(keys), len(writers)
+        return DecodedComposite(
+            keys=list(keys), writers=[int(w) for w in writers],
+            tok=_plane(payload.get("tok"), (ku, wu), "tok"),
+            obs=_plane(payload.get("obs"), (ku, wu, wu), "obs"),
+            pos=_plane(payload.get("pos"), (ku, wu), "pos"),
+            neg=_plane(payload.get("neg"), (ku, wu), "neg"),
+        )
+
+    def _align_locked(self, d: DecodedComposite):
+        """Scatter a decoded payload into THIS node's (capacity-padded)
+        coordinate space.  Both writer axes of obs permute together."""
+        rows = np.asarray([self._kid_locked(k) for k in d.keys], np.int64)
+        cols = np.asarray([self._wcol_locked(r) for r in d.writers], np.int64)
+        tok = np.full((self._k, self._w), -1, np.int32)
+        obs = np.full((self._k, self._w, self._w), -1, np.int32)
+        pos = np.zeros((self._k, self._w), np.int32)
+        neg = np.zeros((self._k, self._w), np.int32)
+        if rows.size and cols.size:
+            tok[np.ix_(rows, cols)] = d.tok
+            obs[np.ix_(rows, cols, cols)] = d.obs
+            pos[np.ix_(rows, cols)] = d.pos
+            neg[np.ix_(rows, cols)] = d.neg
+        return tok, obs, pos, neg
+
+    def merge_decoded(self, decoded: List[DecodedComposite]) -> int:
+        """Fold any number of decoded peer payloads into the local state
+        in ONE jitted dispatch of the registered composite join (module
+        docstring: the k-way fused-ingest discipline).  Returns 1 when the
+        local state changed, 0 on a no-op round."""
+        if not decoded or not self.alive:
+            return 0
+        import jax.numpy as jnp
+
+        from crdt_tpu.models import flags, ormap, pncounter
+
+        with self._lock:
+            # union coordinate space first: alignment needs final capacity
+            for d in decoded:
+                for k in d.keys:
+                    self._kid_locked(k)
+                for r in d.writers:
+                    self._wcol_locked(r)
+            planes = [(self._tok, self._obs, self._pos, self._neg)]
+            planes += [self._align_locked(d) for d in decoded]
+            # pow2-pad with the join identity (empty planes) so the fold's
+            # halving loop stays shape-regular
+            n = 1
+            while n < len(planes):
+                n *= 2
+            while len(planes) < n:
+                planes.append((
+                    np.full((self._k, self._w), -1, np.int32),
+                    np.full((self._k, self._w, self._w), -1, np.int32),
+                    np.zeros((self._k, self._w), np.int32),
+                    np.zeros((self._k, self._w), np.int32),
+                ))
+            stacked = ormap.ORMap(
+                presence=flags.TokenPlane(
+                    tok=jnp.asarray(np.stack([p[0] for p in planes])),
+                    obs=jnp.asarray(np.stack([p[1] for p in planes])),
+                ),
+                values=pncounter.PNCounter(
+                    pos=jnp.asarray(np.stack([p[2] for p in planes])),
+                    neg=jnp.asarray(np.stack([p[3] for p in planes])),
+                ),
+            )
+            out = _fold_fn()(stacked)
+            self.merge_dispatches += 1
+            self.metrics.inc("composite_merge_dispatches")
+            # np.array (not asarray): jax outputs view as read-only, and
+            # the mirrors must stay writable for the local op path
+            tok = np.array(out.presence.tok, np.int32)
+            obs = np.array(out.presence.obs, np.int32)
+            pos = np.array(out.values.pos, np.int32)
+            neg = np.array(out.values.neg, np.int32)
+            changed = not (
+                np.array_equal(tok, self._tok)
+                and np.array_equal(obs, self._obs)
+                and np.array_equal(pos, self._pos)
+                and np.array_equal(neg, self._neg)
+            )
+            self._tok, self._obs, self._pos, self._neg = tok, obs, pos, neg
+            return 1 if changed else 0
+
+    def receive(self, payload: Any) -> int:
+        """Decode + merge one peer payload (the single-peer pull path;
+        raises ValueError on malformed payloads — see decode)."""
+        return self.merge_decoded([self.decode(payload)])
+
+    # ---- snapshot (crash-safe checkpoint sections) ----
+
+    def to_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._dump_locked()
+
+    def from_snapshot(self, snap: Dict[str, Any]) -> None:
+        """Restore from a checkpoint section: validate like a wire payload
+        (a corrupt composite.json raises → load_latest_node quarantines
+        the snapshot) and fold it into a reset state."""
+        decoded = self.decode(snap)
+        with self._lock:
+            self.keys = Interner()
+            self._writers = []
+            self._wcol = {}
+            self._tok = np.full((self._k, self._w), -1, np.int32)
+            self._obs = np.full((self._k, self._w, self._w), -1, np.int32)
+            self._pos = np.zeros((self._k, self._w), np.int32)
+            self._neg = np.zeros((self._k, self._w), np.int32)
+        self.merge_decoded([decoded])

@@ -31,6 +31,7 @@ fleet deterministically, crdt_tpu.harness.crashsoak):
   POST /admin/set_barrier       one set GC barrier now (coordinator)
   POST /admin/map_pull          {"peer": url?} -> one map pull now
   POST /admin/map_barrier       one map reset barrier now (coordinator)
+  POST /admin/composite_pull    {"peer": url?} -> one composite pull now
 
 Set-lattice surface (crdt_tpu.api.setnode; present only with ``admin``):
   GET  /set                     {"members": [...]}
@@ -58,6 +59,16 @@ with reset-wins epoch GC:
   POST /map/upd                 {"key": str, "delta": int} -> mint one op
   POST /map/rem                 {"key": str} -> observed-remove
   POST /map/reset               {"epochs": {key: epoch}} -> adopt reset
+
+Composite surface (crdt_tpu.api.compositenode; present only with
+``admin`` or a cluster carrying composite siblings) — the served
+``mapof(pncounter)`` from the compositional algebra.  State-based: the
+gossip payload is a full trimmed dump, no vv/delta negotiation and no
+GC barrier (the algebra's idempotence + monotonicity ARE the protocol):
+  GET  /composite               {"items": {key: value}}
+  GET  /composite/gossip        full state dump (keys/writers + planes)
+  POST /composite/upd           {"key": str, "delta": int} -> {"value"}
+  POST /composite/rem           {"key": str} -> {"removed": bool}
 
 The /condition route takes the flag as a path segment (also accepted:
 ?alive_status=) — the reference registered the route without the parameter
@@ -122,6 +133,13 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
             if admin is not None:
                 return getattr(admin, "map_node", None)
             nodes = getattr(cluster, "map_nodes", None)
+            return nodes[idx] if nodes else None
+
+        @property
+        def composite_node(self):
+            if admin is not None:
+                return getattr(admin, "composite_node", None)
+            nodes = getattr(cluster, "composite_nodes", None)
             return nodes[idx] if nodes else None
 
         def _parse_vv_query(self, url):
@@ -238,6 +256,27 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 else:
                     self._send(404, "not found")
                 return
+            if parts and parts[0] == "composite" \
+                    and self.composite_node is not None:
+                cn = self.composite_node
+                if url.path == "/composite":
+                    items = cn.items()
+                    if items is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps({"items": items}),
+                                   "application/json")
+                elif url.path == "/composite/gossip":
+                    # state-based: the full trimmed dump, no vv query
+                    payload = cn.gossip_payload()
+                    if payload is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps(payload),
+                                   "application/json")
+                else:
+                    self._send(404, "not found")
+                return
             if url.path == "/metrics":
                 # Prometheus text exposition: the node's whole registry +
                 # the lattice health gauges, sampled at scrape time (the
@@ -245,6 +284,7 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 body = health.render_node_metrics(
                     self.node, set_node=self.set_node,
                     seq_node=self.seq_node, map_node=self.map_node,
+                    composite_node=self.composite_node,
                     agent=getattr(admin, "agent", None),
                 )
                 self._send(200, body, PROM_CTYPE)
@@ -384,6 +424,10 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                             }),
                             "application/json",
                         )
+                    elif path == "/admin/composite_pull":
+                        ok = admin.admin_composite_pull(body.get("peer"))
+                        self._send(200, json.dumps({"pulled": bool(ok)}),
+                                   "application/json")
                     elif path == "/admin/seq_barrier":
                         floor = admin.admin_seq_barrier()
                         self._send(
@@ -555,6 +599,38 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         return
                     mn.adopt_epochs(epochs)
                     self._send(200, "OK")
+                else:
+                    self._send(404, "not found")
+                return
+            if path.startswith("/composite/") \
+                    and self.composite_node is not None:
+                cn = self.composite_node
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    assert isinstance(body, dict)
+                except Exception:
+                    self._send(400, "invalid body")
+                    return
+                if path == "/composite/upd":
+                    try:
+                        delta = int(body.get("delta"))
+                    except (TypeError, ValueError):
+                        self._send(400, "invalid delta")
+                        return
+                    value = cn.upd(str(body.get("key", "")), delta)
+                    if value is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps({"value": value}),
+                                   "application/json")
+                elif path == "/composite/rem":
+                    removed = cn.rem(str(body.get("key", "")))
+                    if removed is None:
+                        self._send(502, "Unreachable")
+                    else:
+                        self._send(200, json.dumps({"removed": removed}),
+                                   "application/json")
                 else:
                     self._send(404, "not found")
                 return

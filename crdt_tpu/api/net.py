@@ -69,6 +69,7 @@ class RemotePeer:
         self.serves_set: Optional[bool] = None
         self.serves_seq: Optional[bool] = None  # same, for /seq/gossip
         self.serves_map: Optional[bool] = None  # same, for /map/gossip
+        self.serves_composite: Optional[bool] = None  # /composite/gossip
         # per-peer circuit breaker over TRANSPORT failures (connection
         # refused / socket timeout — the peer's process or network is
         # gone): after ``failure_threshold`` consecutive failures the
@@ -361,6 +362,15 @@ class RemotePeer:
             {"epochs": {str(k): int(e) for k, e in epochs.items()}},
         )
 
+    # ---- composite surface (crdt_tpu.api.compositenode) ----
+
+    def composite_gossip_payload(self) -> Optional[Dict[str, Any]]:
+        """GET /composite/gossip — the full state dump.  State-based, so
+        there is no ``since``/vv negotiation to carry (idempotent +
+        monotone joins make duplicate and stale delivery no-ops; see the
+        compositenode module docstring)."""
+        return self._probe_get("/composite/gossip", "serves_composite")
+
 
 def network_compact(node: ReplicaNode, peers: List[RemotePeer]) -> Dict[int, int]:
     """One cross-daemon compaction barrier (the network analogue of
@@ -421,11 +431,16 @@ class NetworkAgent:
         set_node=None,
         seq_node=None,
         map_node=None,
+        composite_node=None,
     ):
         self.node = node
         self.set_node = set_node  # optional SetNode sibling: pulled together
         self.seq_node = seq_node  # optional SeqNode sibling: pulled together
         self.map_node = map_node  # optional MapNode sibling: pulled together
+        # optional algebra-derived composite sibling (compositenode.py):
+        # pulled together, but state-based — fused rounds fold its k peer
+        # payloads in ONE extra dispatch (_composite_pull_fused)
+        self.composite_node = composite_node
         self.config = config or ClusterConfig()
         self.peers = [
             RemotePeer(
@@ -474,6 +489,7 @@ class NetworkAgent:
         self.set_pull(peer)
         self.seq_pull(peer)
         self.map_pull(peer)
+        self.composite_pull(peer)
         return merged
 
     def pull_from(self, peer: RemotePeer) -> bool:
@@ -550,12 +566,17 @@ class NetworkAgent:
             trace=tid,
             quarantine=True,
         )
-        for peer, body in zip(peers, payloads):
-            if body is None:
-                continue  # unreachable this round: don't re-pay the timeout
+        responding = [p for p, body in zip(peers, payloads) if body is not None]
+        for peer in responding:
+            # unreachable-this-round peers are skipped: don't re-pay the
+            # timeout.  The set/seq/map hosts are pure-dict joins with no
+            # device dispatch to fuse — per-peer pulls are fine.
             self.set_pull(peer)
             self.seq_pull(peer)
             self.map_pull(peer)
+        # the composite IS a device lattice: its k payloads fold in one
+        # dispatch, keeping the fused round at one dispatch per lattice
+        self._composite_pull_fused(responding)
         return merged
 
     def set_pull(self, peer: RemotePeer) -> bool:
@@ -706,6 +727,76 @@ class NetworkAgent:
         self.metrics.inc("map_gossip_rounds" if fresh else "map_gossip_noop")
         return fresh > 0
 
+    def composite_pull(self, peer: RemotePeer) -> bool:
+        """One composite-lattice pull from ``peer`` (no-op without a
+        composite node) — the algebra sibling of map_pull, minus the vv:
+        the payload is the peer's full state and the merge is the
+        REGISTERED ``mapof(pncounter)`` join (compositenode docstring)."""
+        cn = self.composite_node
+        if cn is None or not cn.alive:
+            return False
+        payload = peer.composite_gossip_payload()
+        if payload is None:
+            self.metrics.inc(
+                "composite_gossip_unsupported"
+                if peer.serves_composite is False
+                else "composite_gossip_skipped"
+            )
+            return False
+        fresh = self._receive_quarantined(cn, payload, "composite_gossip",
+                                          peer)
+        self.metrics.inc(
+            "composite_gossip_rounds" if fresh else "composite_gossip_noop")
+        if fresh:
+            # black-box provenance: composite merges land in the same JSONL
+            # event stream the flight recorder assembles (obs/assemble.py)
+            self.node.events.emit(
+                "composite_merge", peer=peer.url, n_payloads=1,
+                keys=len(cn.keys),
+            )
+        return fresh > 0
+
+    def _composite_pull_fused(self, peers: List[RemotePeer]) -> bool:
+        """The composite leg of a k-way fused round: fetch every responding
+        peer's state concurrently, decode each (per-peer quarantine), then
+        fold ALL of them into the local state in ONE jitted dispatch
+        (CompositeNode.merge_decoded) — the composite pays the same
+        dispatch bill for k peers as for one."""
+        cn = self.composite_node
+        if cn is None or not cn.alive or not peers:
+            return False
+        with ThreadPoolExecutor(max_workers=len(peers)) as pool:
+            payloads = list(pool.map(
+                lambda p: p.composite_gossip_payload(), peers))
+        decoded = []
+        for peer, payload in zip(peers, payloads):
+            if payload is None:
+                self.metrics.inc(
+                    "composite_gossip_unsupported"
+                    if peer.serves_composite is False
+                    else "composite_gossip_skipped"
+                )
+                continue
+            try:
+                decoded.append(cn.decode(payload))
+            except (ValueError, KeyError, TypeError, AttributeError) as e:
+                self.metrics.inc("composite_gossip_quarantined")
+                self.node.events.emit(
+                    "payload_quarantine", surface="composite_gossip",
+                    peer=peer.url, error=f"{type(e).__name__}: {e}"[:200],
+                )
+        if not decoded:
+            return False
+        fresh = cn.merge_decoded(decoded)
+        self.metrics.inc(
+            "composite_gossip_rounds" if fresh else "composite_gossip_noop")
+        if fresh:
+            self.node.events.emit(
+                "composite_merge", peer="fused", n_payloads=len(decoded),
+                keys=len(cn.keys),
+            )
+        return fresh > 0
+
     def map_reset_once(self):
         """One cross-daemon map RESET barrier (coordinator only): the
         full-fleet rule of ormap_gc.reset_barrier over the network
@@ -806,6 +897,7 @@ class NodeHost:
         step_clock=None,
         birth_ledger=None,
     ):
+        from crdt_tpu.api.compositenode import CompositeNode
         from crdt_tpu.api.http_shim import _make_handler
         from crdt_tpu.api.mapnode import MapNode
         from crdt_tpu.api.seqnode import SeqNode
@@ -849,6 +941,13 @@ class NodeHost:
         # the map-lattice sibling (crdt_tpu.api.mapnode): the concrete
         # PN-composition map with reset-wins epoch GC, same deployment
         self.map_node = MapNode(rid=rid)
+        # the algebra-derived composite sibling (crdt_tpu.api
+        # .compositenode): the served mapof(pncounter) — its merge is the
+        # registered composite join, its wire is a full state dump.
+        # Shares the node's metrics so merge-dispatch counters land in the
+        # registry GET /metrics renders.
+        self.composite_node = CompositeNode(rid=rid,
+                                            metrics=self.node.metrics)
         # crash recovery: restore the newest complete snapshot (if any)
         # BEFORE serving.  The caller is responsible for minting rid via
         # checkpoint.bump_incarnation when restores can land in a live
@@ -864,12 +963,13 @@ class NodeHost:
             self.restored = ckpt.load_latest_node(
                 checkpoint_dir, self.node, set_node=self.set_node,
                 seq_node=self.seq_node, map_node=self.map_node,
+                composite_node=self.composite_node,
             )
         self.nodes = [self.node]  # duck-types as a cluster for the handler
         self.agent = NetworkAgent(
             self.node, peers, self.config, coordinator=coordinator,
             set_node=self.set_node, seq_node=self.seq_node,
-            map_node=self.map_node,
+            map_node=self.map_node, composite_node=self.composite_node,
         )
         self._server = ThreadingHTTPServer(
             (host, port), _make_handler(self, 0, admin=self)
@@ -957,6 +1057,7 @@ class NodeHost:
         return ckpt.save_node_atomic(
             self.checkpoint_dir, self.node, set_node=self.set_node,
             seq_node=self.seq_node, map_node=self.map_node,
+            composite_node=self.composite_node,
         )
 
     def admin_pull(self, peer_url: Optional[str] = None) -> bool:
@@ -1013,6 +1114,17 @@ class NodeHost:
         else:
             peer = RemotePeer(peer_url)
         return self.agent.map_pull(peer)
+
+    def admin_composite_pull(self, peer_url: Optional[str] = None) -> bool:
+        """One composite-lattice pull, now, from ``peer_url`` (or a random
+        configured peer)."""
+        if peer_url is None:
+            if not self.agent.peers:
+                return False
+            peer = self.agent._rng.choice(self.agent.peers)
+        else:
+            peer = RemotePeer(peer_url)
+        return self.agent.composite_pull(peer)
 
     def admin_map_barrier(self) -> dict:
         """One map reset barrier, now (coordinator only); returns

@@ -72,6 +72,8 @@ class NemesisReport:
     payload_quarantines: int = 0
     snapshot_quarantines: int = 0
     final_keys: int = 0
+    composite_ops: int = 0
+    final_composite_keys: int = 0
     propagation: Dict[str, float] = dataclasses.field(default_factory=dict)
     blame_coverage: Optional[float] = None
 
@@ -90,6 +92,9 @@ class NemesisReport:
             )
         if self.blame_coverage is not None:
             prop += f"; blame coverage {self.blame_coverage:.3f}"
+        if self.composite_ops:
+            prop += (f"; composite: {self.composite_ops} ops -> "
+                     f"{self.final_composite_keys} keys")
         return (
             f"seed {self.seed}: {self.steps} steps x {self.nodes} nodes — "
             f"{self.writes} writes, {self.pulls} pulls ({self.merges} "
@@ -180,15 +185,27 @@ class _Slot:
 
 
 class NemesisSoak:
+    #: composite-mode key pool: small on purpose — contention on shared
+    #: keys is what exercises concurrent upd/rem token races
+    COMPOSITE_KEYS = ("alpha", "beta", "gamma", "delta")
+
     def __init__(self, seed: int, nodes: int = 3, steps: int = 120,
                  fault_log: Optional[str] = None,
                  postmortem_dir: Optional[str] = None,
-                 assemble_check: bool = False):
+                 assemble_check: bool = False,
+                 composite: bool = False):
         assert nodes >= 2, "nemesis needs a fleet (>= 2 nodes)"
         self.seed = seed
         self.steps = steps
         self.postmortem_dir = postmortem_dir
         self.assemble_check = assemble_check
+        # composite mode: the served mapof(pncounter) (api/compositenode)
+        # rides every phase — writes mix in composite upd/rem, every edge
+        # pull also pulls the composite surface through the SAME faulty
+        # transport, convergence additionally requires fingerprint
+        # equality, and the quarantine ledger must account for corrupted
+        # composite payloads 1:1
+        self.composite = composite
         self._tmp = tempfile.TemporaryDirectory(prefix="nemesis_soak_")
         self.root = self._tmp.name
         self.schedule = NemesisSchedule.generate(seed, nodes, steps)
@@ -225,6 +242,18 @@ class NemesisSoak:
 
     def _write(self) -> None:
         slot = self.rng.choice(self._alive())
+        if self.composite and self.rng.random() < 0.4:
+            # composite-mode write: upd/rem on the contended key pool.
+            # Deliberately NOT in self.writes — the composite has no
+            # (rid, seq) ledger; its oracle is fingerprint equality
+            key = self.rng.choice(self.COMPOSITE_KEYS)
+            cn = slot.host.composite_node
+            if self.rng.random() < 0.25:
+                cn.rem(key)
+            else:
+                cn.upd(key, self.rng.randint(-9, 9))
+            self.report.composite_ops += 1
+            return
         rid = slot.host.node.rid
         seq = self.writes.get(rid, 0)
         if slot.host.node.add_command({f"k{rid}-{seq}": f"v{rid}-{seq}"}):
@@ -241,6 +270,10 @@ class NemesisSoak:
         self.report.pulls += 1
         if src.host.agent.pull_from(t):
             self.report.merges += 1
+        if self.composite:
+            # the composite rides the same edge through the same faulty
+            # transport: its payload crosses the nemesis too
+            src.host.agent.composite_pull(t)
 
     def _checkpoint(self) -> None:
         slot = self.rng.choice(self._alive())
@@ -248,6 +281,7 @@ class NemesisSoak:
         _, torn = slot.disk.save(
             slot.ckpt_dir, h.node, set_node=h.set_node,
             seq_node=h.seq_node, map_node=h.map_node,
+            composite_node=h.composite_node,
         )
         self.report.checkpoints += 1
         if torn:
@@ -299,7 +333,8 @@ class NemesisSoak:
             self.report.reboots += 1
         h = slot.host
         slot.disk.save(slot.ckpt_dir, h.node, set_node=h.set_node,
-                       seq_node=h.seq_node, map_node=h.map_node)
+                       seq_node=h.seq_node, map_node=h.map_node,
+                       composite_node=h.composite_node)
         # this write rides ONLY the (about to be torn) newest generation
         # and is never gossiped: the fallback restore must drop it, and
         # the prefix oracle must see the fleet vv stop just short of it
@@ -311,6 +346,7 @@ class NemesisSoak:
         snap_b, _ = slot.disk.save(
             slot.ckpt_dir, h.node, set_node=h.set_node,
             seq_node=h.seq_node, map_node=h.map_node,
+            composite_node=h.composite_node,
         )
         self.report.checkpoints += 2
         slot.crash()
@@ -348,7 +384,15 @@ class NemesisSoak:
         if any(t.pending_redelivery()
                for s in self.slots for t in s.transports.values()):
             return False
-        return all(st == states[0] for st in states[1:])
+        if not all(st == states[0] for st in states[1:]):
+            return False
+        if self.composite:
+            # intern orders differ per node: fingerprint() is the
+            # canonical comparable form (compositenode docstring)
+            fps = [s.host.composite_node.fingerprint() for s in self.slots]
+            if not all(fp == fps[0] for fp in fps[1:]):
+                return False
+        return True
 
     def _converge(self, max_rounds: int) -> None:
         for r in range(1, max_rounds + 1):
@@ -359,6 +403,8 @@ class NemesisSoak:
                     if t.backed_off():
                         continue
                     src.host.agent.pull_from(t)
+                    if self.composite:
+                        src.host.agent.composite_pull(t)
                 health.sample_peer_circuits(
                     src.host.node.metrics.registry, str(src.slot),
                     src.transports.values(),
@@ -413,7 +459,8 @@ class NemesisSoak:
         payload_quarantine event (the loop survived it)."""
         gossip_corrupts = sum(
             1 for rec in self.plane.log
-            if rec["fault"] == "corrupt" and rec.get("op") == "gossip"
+            if rec["fault"] == "corrupt"
+            and rec.get("op") in ("gossip", "composite_gossip")
         )
         payload_q = snap_q = 0
         for s in self.slots:
@@ -455,6 +502,18 @@ class NemesisSoak:
             "duplicate/reorder delivery mutated a converged node: "
             f"{snap} -> {after}"
         )
+        if self.composite:
+            # same laws for the composite: replaying a peer's full state
+            # twice against the converged fleet must be a no-op
+            ca = self.slots[0].host.composite_node
+            cb = self.slots[1].host.composite_node
+            fp = ca.fingerprint()
+            payload = cb.gossip_payload()
+            ca.receive(payload)
+            ca.receive(payload)
+            assert ca.fingerprint() == fp, (
+                "duplicate composite delivery mutated a converged node"
+            )
 
     def heal_and_check(self, max_rounds: int = 80) -> NemesisReport:
         self.plane.heal()
@@ -467,6 +526,9 @@ class NemesisSoak:
         self._check_prefix_oracle()
         self._check_idempotence()
         self._check_quarantine_provenance()
+        if self.composite:
+            self.report.final_composite_keys = len(
+                self.slots[0].host.composite_node.items())
         self.report.fault_counts = self.plane.counts()
         self.report.propagation = propagation_summary(
             *(s.host.node.metrics.registry for s in self.slots)
@@ -541,10 +603,12 @@ class NemesisSoak:
 def run_soak(seed: int, nodes: int, steps: int,
              fault_log: Optional[str] = None,
              postmortem_dir: Optional[str] = None,
-             assemble_check: bool = False) -> NemesisReport:
+             assemble_check: bool = False,
+             composite: bool = False) -> NemesisReport:
     return NemesisSoak(seed, nodes=nodes, steps=steps,
                        fault_log=fault_log, postmortem_dir=postmortem_dir,
-                       assemble_check=assemble_check).run()
+                       assemble_check=assemble_check,
+                       composite=composite).run()
 
 
 def main(argv=None) -> int:
@@ -567,6 +631,9 @@ def main(argv=None) -> int:
                          "convergence-lag spikes")
     ap.add_argument("--postmortem-dir", default=".",
                     help="where postmortem-<seed>.tar.gz lands on failure")
+    ap.add_argument("--composite", action="store_true",
+                    help="also serve + fault + converge the algebra-"
+                         "derived mapof(pncounter) composite node")
     args = ap.parse_args(argv)
     for k in range(args.seeds):
         seed = args.seed_base + k
@@ -576,9 +643,11 @@ def main(argv=None) -> int:
                 log_b = str(pathlib.Path(d) / "b.jsonl")
                 rep = run_soak(seed, args.nodes, args.steps, fault_log=log_a,
                                postmortem_dir=args.postmortem_dir,
-                               assemble_check=args.assemble_check)
+                               assemble_check=args.assemble_check,
+                               composite=args.composite)
                 run_soak(seed, args.nodes, args.steps, fault_log=log_b,
-                         postmortem_dir=args.postmortem_dir)
+                         postmortem_dir=args.postmortem_dir,
+                         composite=args.composite)
                 a = pathlib.Path(log_a).read_bytes()
                 b = pathlib.Path(log_b).read_bytes()
                 assert a == b, (
@@ -590,7 +659,8 @@ def main(argv=None) -> int:
             rep = run_soak(seed, args.nodes, args.steps,
                            fault_log=args.fault_log,
                            postmortem_dir=args.postmortem_dir,
-                           assemble_check=args.assemble_check)
+                           assemble_check=args.assemble_check,
+                           composite=args.composite)
             print(f"[nemesis] {rep.summary()}")
     return 0
 

@@ -1,0 +1,107 @@
+"""Derived composite models: the shipped instances of the CRDT algebra.
+
+Where every other file in ``crdt_tpu/models`` is a bespoke lattice, this
+one contains **no join logic at all** — only the generic :class:`Pair`
+pytree the pair-shaped combinators use, the one action function the
+semidirect demo needs, and the registrations that derive real models
+from existing parts via ``crdt_tpu.ops.algebra``:
+
+* ``mapof(pncounter)``            — ormap-of-counters: the composed join
+  is bit-identical to the bespoke ``ormap.join`` with a vmapped
+  ``pncounter.join`` (tests/test_algebra.py pins the parity on
+  randomized op traces), and it is the lattice the servable
+  :class:`~crdt_tpu.api.compositenode.CompositeNode` gossips;
+* ``lexicographic(lww,mvregister)`` — a register whose value is decided
+  by last-writer-wins but which surfaces the concurrent-sibling set of
+  the *winning write's era* as metadata: the (ts, rid) packed key is the
+  total-order rank, so the whole mv-plane rides whichever write wins;
+* ``semidirect(gcounter,pncounter)`` — an epoch-reset counter: the
+  gcounter a-part is the epoch frame, and ``reset_act`` zeroes any
+  pncounter contribution observed in a strictly older epoch — bumping
+  the epoch resets the counter fleet-wide without unwinding monotonicity;
+* ``product(gcounter,pncounter)``   — the minimal product demo; both
+  parts claim structural commutativity, so the composite does too and
+  crdtlint's CRDT103 verifies the *composed* jaxpr's operand symmetry.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class Pair:
+    """The generic two-part composite state (product / lexicographic /
+    semidirect all share it): a pytree pair of part states."""
+
+    fst: Any
+    snd: Any
+
+
+def lww_rank(reg) -> jax.Array:
+    """Total-order rank of an LWW register: the order-preserving packed
+    (ts, rid) key (crdt_tpu.models.lww.pack).  Distinct reachable states
+    have distinct (ts, rid) — the chain property lexicographic needs."""
+    from crdt_tpu.models import lww
+
+    return lww.pack(reg).key
+
+
+def reset_act(frame, observed, counter):
+    """Semidirect action of the epoch gcounter on the pncounter: a
+    contribution observed in a strictly older epoch frame is reset to
+    zero before joining; same-epoch contributions ride through untouched.
+
+    Satisfies the three act laws (crdt_tpu.ops.algebra docstring):
+    identity (same frame ⇒ not stale), composition (epoch values only
+    grow along join chains, so "ever stale" == "stale vs the final
+    frame"), and join-homomorphism (a where-mask with a side-independent
+    condition distributes over the elementwise-max pncounter join).
+    """
+    from crdt_tpu.models import gcounter
+
+    stale = gcounter.value(observed) < gcounter.value(frame)
+    return jax.tree.map(lambda leaf: jnp.where(stale, 0, leaf), counter)
+
+
+def epoch_bump(state: Pair, node: int) -> Pair:
+    """Local op on the epoch-reset counter: advance the epoch — every
+    contribution of the old epoch (local and remote, once merged) resets."""
+    from crdt_tpu.models import gcounter
+
+    return Pair(fst=gcounter.increment(state.fst, node), snd=state.snd)
+
+
+def epoch_add(state: Pair, node: int, amount: int) -> Pair:
+    """Local op on the epoch-reset counter: count within the current epoch."""
+    from crdt_tpu.models import pncounter
+
+    return Pair(fst=state.fst, snd=pncounter.add(state.snd, node, amount))
+
+
+def epoch_value(state: Pair) -> jax.Array:
+    from crdt_tpu.models import pncounter
+
+    return pncounter.value(state.snd)
+
+
+_REGISTERED = False
+
+
+def register_builtin_composites() -> None:
+    """Derive + register the shipped composite models (idempotent; called
+    from crdt_tpu.ops.joins._register_builtin_joins)."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    _REGISTERED = True
+
+    from crdt_tpu.ops import algebra
+
+    algebra.mapof("pncounter")
+    algebra.lexicographic("lww", "mvregister", rank=lww_rank)
+    algebra.semidirect("gcounter", reset_act, "pncounter")
+    algebra.product("gcounter", "pncounter")

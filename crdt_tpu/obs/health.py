@@ -124,6 +124,17 @@ def sample_map_node(registry, mn) -> None:
     registry.set_gauge("map_records", mn.n_records(), node=str(mn.rid))
 
 
+def sample_composite_node(registry, cn) -> None:
+    lab = str(cn.rid)
+    items = cn.items()
+    registry.set_gauge("composite_keys",
+                       0 if items is None else len(items), node=lab)
+    # interned keys may exceed live keys (removed entries keep history);
+    # the gap is the composite's tombstone pressure
+    registry.set_gauge("composite_keys_interned", len(cn.keys), node=lab)
+    registry.set_gauge("composite_writers", len(cn._writers), node=lab)
+
+
 def sample_peer_circuits(registry, node_label: str, peers) -> None:
     """Partition-state gauges from the NetworkAgent's RemotePeer circuit
     breakers: per-peer breaker state (0 closed / 1 half-open / 2 open),
@@ -148,7 +159,7 @@ def sample_peer_circuits(registry, node_label: str, peers) -> None:
 
 
 def sample_all(registry, node, set_node=None, seq_node=None,
-               map_node=None, agent=None) -> None:
+               map_node=None, composite_node=None, agent=None) -> None:
     sample_kv_node(registry, node)
     if set_node is not None:
         sample_set_node(registry, set_node)
@@ -156,15 +167,19 @@ def sample_all(registry, node, set_node=None, seq_node=None,
         sample_seq_node(registry, seq_node)
     if map_node is not None:
         sample_map_node(registry, map_node)
+    if composite_node is not None:
+        sample_composite_node(registry, composite_node)
     if agent is not None:
         sample_peer_circuits(registry, str(node.rid), agent.peers)
 
 
 def render_node_metrics(node, set_node=None, seq_node=None,
-                        map_node=None, agent=None) -> str:
+                        map_node=None, composite_node=None,
+                        agent=None) -> str:
     """The GET /metrics body: sample health gauges into the node's
     registry, then render the whole registry as Prometheus text."""
     registry = node.metrics.registry
     sample_all(registry, node, set_node=set_node, seq_node=seq_node,
-               map_node=map_node, agent=agent)
+               map_node=map_node, composite_node=composite_node,
+               agent=agent)
     return registry.render_prometheus()

@@ -1,0 +1,278 @@
+"""Compositional CRDT algebra: lattice combinators over the join registry.
+
+"Composing and Decomposing Op-Based CRDTs with Semidirect Products"
+(PAPERS.md) gives the recipe this module implements for the state-based
+registry: instead of a bespoke model file per scenario, new lattices are
+*derived* from registered parts —
+
+* ``product(a, b)``        — componentwise join over a :class:`Pair`;
+* ``lexicographic(a, b, rank)`` — a-dominates by a total-order rank key,
+  b joins only on rank ties (``jnp.where`` selects: stays jittable);
+* ``mapof(inner)``         — add-wins keyed map of any registered lattice,
+  reusing the ormap presence machinery (token plane + vmapped inner join);
+* ``semidirect(a, act, b)`` — b's state transported into the joined
+  a-frame by ``act`` before joining.
+
+Every combinator returns a **registered** :class:`~crdt_tpu.ops.joins
+.JoinSpec`: the composite's neutral element and randomized-state
+generator are derived from its parts, so the composite flows through the
+registry-wide ACI law sweep (tests/test_lattice_laws.py), crdtlint's
+jaxpr gate (CRDT101–103 on the *composed* jaxpr, CRDT104 on metadata
+propagation), `converge`/`tree_reduce_join`, and the serving stack
+(crdt_tpu.api.compositenode) with no further wiring.
+
+Metadata propagation (the CRDT104 contract)
+-------------------------------------------
+``structurally_commutative`` — the strong static claim that the jaxpr is
+operand-swap symmetric — propagates as:
+
+=================  =========================================
+combinator         structurally_commutative
+=================  =========================================
+product            AND of both parts
+mapof              inner's claim (the presence plane is a
+                   pure max lattice, i.e. True)
+lexicographic      False (rank-compare selects break operand
+                   symmetry even over symmetric parts)
+semidirect         False (the action is applied per-side)
+=================  =========================================
+
+Laws required of ``act`` (checked at runtime by tests/test_algebra.py,
+not provable statically) for ``semidirect(a, act, b)`` to be a lattice:
+
+1. **identity**      ``act(f, f, b) == b`` — transporting within the
+   same frame is a no-op;
+2. **composition**   ``act(f3, f2, act(f2, f1, b)) == act(f3, f1, b)``
+   for monotone frame chains ``f1 <= f2 <= f3`` (frames only grow:
+   ``join_a`` is inflationary);
+3. **join-homomorphism**  ``act(f, g, join_b(x, y)) ==
+   join_b(act(f, g, x), act(f, g, y))`` — transport distributes over the
+   b-join.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.ops.joins import JoinSpec, register_join, registered_joins
+
+
+def resolve(spec: Union[JoinSpec, str]) -> JoinSpec:
+    """A registered JoinSpec, from itself or its registry name."""
+    if isinstance(spec, JoinSpec):
+        return spec
+    registry = registered_joins()
+    if spec not in registry:
+        raise KeyError(
+            f"no registered join named {spec!r}; known: {sorted(registry)}"
+        )
+    return registry[spec]
+
+
+def _tree_select(cond, x, y):
+    """Pytree-wide ``jnp.where`` (``cond`` broadcasts against every leaf)."""
+    return jax.tree.map(lambda u, v: jnp.where(cond, u, v), x, y)
+
+
+def _derived_rand(build: Callable, *parts: JoinSpec):
+    """Compose part generators into a composite generator; None if any
+    part registered none (the law sweep then skips, loudly)."""
+    if any(p.rand is None for p in parts):
+        return None
+    return build
+
+
+# ---- product ----------------------------------------------------------------
+
+
+def product(a: Union[JoinSpec, str], b: Union[JoinSpec, str], *,
+            name: Optional[str] = None) -> JoinSpec:
+    """Componentwise product lattice over a :class:`Pair` of the parts.
+
+    The join is ``Pair(join_a(x.fst, y.fst), join_b(x.snd, y.snd))`` —
+    ACI holds iff it holds for both parts, and the metadata claim is the
+    AND of the parts' claims.
+    """
+    from crdt_tpu.models.composite import Pair
+
+    a, b = resolve(a), resolve(b)
+    name = name or f"product({a.name},{b.name})"
+    join_a, join_b = a.join, b.join
+
+    def join(x: Pair, y: Pair) -> Pair:
+        return Pair(fst=join_a(x.fst, y.fst), snd=join_b(x.snd, y.snd))
+
+    neutral = None
+    if a.neutral is not None and b.neutral is not None:
+        na, nb = a.neutral, b.neutral
+        neutral = lambda: Pair(fst=na(), snd=nb())  # noqa: E731
+
+    def rand(rng) -> Pair:
+        return Pair(fst=a.rand(rng), snd=b.rand(rng))
+
+    return register_join(
+        name, join,
+        lambda: (Pair(fst=a.example()[0], snd=b.example()[0]),
+                 Pair(fst=a.example()[1], snd=b.example()[1])),
+        structurally_commutative=(a.structurally_commutative
+                                  and b.structurally_commutative),
+        neutral=neutral,
+        rand=_derived_rand(rand, a, b),
+        parts=(a.name, b.name),
+    )
+
+
+# ---- lexicographic ----------------------------------------------------------
+
+
+def lexicographic(a: Union[JoinSpec, str], b: Union[JoinSpec, str],
+                  rank: Callable[[Any], Any], *,
+                  name: Optional[str] = None) -> JoinSpec:
+    """Lexicographic composition: the a-part dominates, b tiebreaks.
+
+    ``rank`` maps an a-state to a scalar (or per-instance) total-order
+    key; the side with the greater rank is taken *whole*, and only on
+    rank ties do both parts join.  For this to be a lattice join the
+    a-part must be a **chain** under ``rank`` over reachable states:
+    distinct reachable a-states have distinct ranks (equal rank ⇒
+    identical state).  lww's packed ``(ts, rid)`` key is the canonical
+    instance.  Claims ``structurally_commutative=False``: the selects are
+    extensionally symmetric but not operand-symmetric jaxprs.
+    """
+    from crdt_tpu.models.composite import Pair
+
+    a, b = resolve(a), resolve(b)
+    name = name or f"lexicographic({a.name},{b.name})"
+    join_a, join_b = a.join, b.join
+
+    def join(x: Pair, y: Pair) -> Pair:
+        kx, ky = rank(x.fst), rank(y.fst)
+        x_dom, y_dom = kx > ky, ky > kx
+        fst = _tree_select(x_dom, x.fst,
+                           _tree_select(y_dom, y.fst, join_a(x.fst, y.fst)))
+        snd = _tree_select(x_dom, x.snd,
+                           _tree_select(y_dom, y.snd, join_b(x.snd, y.snd)))
+        return Pair(fst=fst, snd=snd)
+
+    neutral = None
+    if a.neutral is not None and b.neutral is not None:
+        na, nb = a.neutral, b.neutral
+        neutral = lambda: Pair(fst=na(), snd=nb())  # noqa: E731
+
+    def rand(rng) -> Pair:
+        return Pair(fst=a.rand(rng), snd=b.rand(rng))
+
+    return register_join(
+        name, join,
+        lambda: (Pair(fst=a.example()[0], snd=b.example()[0]),
+                 Pair(fst=a.example()[1], snd=b.example()[1])),
+        structurally_commutative=False,
+        neutral=neutral,
+        rand=_derived_rand(rand, a, b),
+        parts=(a.name, b.name),
+    )
+
+
+# ---- mapof ------------------------------------------------------------------
+
+
+def mapof(inner: Union[JoinSpec, str], *, n_keys: int = 4,
+          n_writers: int = 4, name: Optional[str] = None) -> JoinSpec:
+    """Add-wins keyed map of any registered lattice.
+
+    The state is the existing :class:`~crdt_tpu.models.ormap.ORMap`: an
+    observed-remove presence token plane over ``n_keys`` interned keys +
+    a ``[n_keys, ...]``-batched inner value plane; the join is
+    ``plane_join × vmap(inner.join)`` — exactly the bespoke
+    ``ormap.join`` with the inner join slotted in, which is what makes
+    the ``mapof(pncounter)`` ↔ ``ormap`` parity equivalence hold by
+    construction.  The registered join is shape-generic (any key/writer
+    count); ``n_keys``/``n_writers`` only size the example/neutral/rand
+    states.  Metadata: the presence plane is a pure max lattice, so the
+    claim is the inner part's claim.
+    """
+    from crdt_tpu.models import ormap
+
+    inner = resolve(inner)
+    name = name or f"mapof({inner.name})"
+    value_join_batched = jax.vmap(inner.join)
+
+    def join(x, y):
+        return ormap.join(x, y, value_join_batched)
+
+    neutral = None
+    if inner.neutral is not None:
+        inz = inner.neutral
+        neutral = lambda: ormap.empty(n_keys, n_writers, inz())  # noqa: E731
+
+    def rand(rng):
+        from crdt_tpu.models import flags
+
+        vals = [inner.rand(rng) for _ in range(n_keys)]
+        values = jax.tree.map(lambda *xs: jnp.stack(xs), *vals)
+        presence = flags.TokenPlane(
+            tok=jnp.asarray(
+                rng.integers(-1, 4, (n_keys, n_writers)), jnp.int32),
+            obs=jnp.asarray(
+                rng.integers(-1, 4, (n_keys, n_writers, n_writers)),
+                jnp.int32),
+        )
+        return ormap.ORMap(presence=presence, values=values)
+
+    return register_join(
+        name, join,
+        structurally_commutative=inner.structurally_commutative,
+        neutral=neutral,
+        rand=_derived_rand(rand, inner),
+        parts=(inner.name,),
+    )
+
+
+# ---- semidirect -------------------------------------------------------------
+
+
+def semidirect(a: Union[JoinSpec, str],
+               act: Callable[[Any, Any, Any], Any],
+               b: Union[JoinSpec, str], *,
+               name: Optional[str] = None) -> JoinSpec:
+    """Semidirect product: b's state transported by a's action, then joined.
+
+    ``join((xa, xb), (ya, yb)) = (za, join_b(act(za, xa, xb),
+    act(za, ya, yb)))`` with ``za = join_a(xa, ya)`` — the state-based
+    form of the paper's op-based construction: each side's b-state is
+    transported from the frame it was observed in (its own a-part) into
+    the joined frame before the b-join resolves.  ``act(frame, from, b)``
+    must satisfy the identity / composition / join-homomorphism laws in
+    the module docstring; the epoch-reset counter
+    (crdt_tpu.models.composite.reset_act) is the shipped instance.
+    """
+    from crdt_tpu.models.composite import Pair
+
+    a, b = resolve(a), resolve(b)
+    name = name or f"semidirect({a.name},{b.name})"
+    join_a, join_b = a.join, b.join
+
+    def join(x: Pair, y: Pair) -> Pair:
+        za = join_a(x.fst, y.fst)
+        zb = join_b(act(za, x.fst, x.snd), act(za, y.fst, y.snd))
+        return Pair(fst=za, snd=zb)
+
+    neutral = None
+    if a.neutral is not None and b.neutral is not None:
+        na, nb = a.neutral, b.neutral
+        neutral = lambda: Pair(fst=na(), snd=nb())  # noqa: E731
+
+    def rand(rng) -> Pair:
+        return Pair(fst=a.rand(rng), snd=b.rand(rng))
+
+    return register_join(
+        name, join,
+        lambda: (Pair(fst=a.example()[0], snd=b.example()[0]),
+                 Pair(fst=a.example()[1], snd=b.example()[1])),
+        structurally_commutative=False,
+        neutral=neutral,
+        rand=_derived_rand(rand, a, b),
+        parts=(a.name, b.name),
+    )

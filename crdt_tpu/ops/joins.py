@@ -13,7 +13,7 @@ The reference converges a swarm by many pairwise gossip merges
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -83,15 +83,53 @@ def pad_to_pow2(state: Any, neutral: Any) -> Any:
     )
 
 
-def tree_reduce_join(join_fn: Callable, state: Any, neutral: Any) -> Any:
+def _as_batched_join_and_neutral(join_fn, neutral):
+    """Resolve the (join_fn, neutral) pair the reduction drivers consume.
+
+    ``join_fn`` may be a bare batched callable (the historical calling
+    convention — ``neutral`` is then required), a :class:`JoinSpec`, or a
+    registered join *name*; for the latter two the single-instance join is
+    vmapped and the neutral element comes from the registry, so callers
+    stop threading identity elements by hand.
+    """
+    if isinstance(join_fn, str):
+        registry = registered_joins()
+        if join_fn not in registry:
+            raise KeyError(
+                f"no registered join named {join_fn!r}; "
+                f"known: {sorted(registry)}"
+            )
+        join_fn = registry[join_fn]
+    if isinstance(join_fn, JoinSpec):
+        spec = join_fn
+        if neutral is None:
+            if spec.neutral is None:
+                raise ValueError(
+                    f"join {spec.name!r} registered no neutral element; "
+                    "pass one explicitly"
+                )
+            neutral = spec.neutral()
+        return batched(spec.join), neutral
+    if neutral is None:
+        raise ValueError(
+            "neutral is required when join_fn is a bare callable; pass a "
+            "JoinSpec or registered name to derive it from the registry"
+        )
+    return join_fn, neutral
+
+
+def tree_reduce_join(join_fn: Union[Callable, "JoinSpec", str], state: Any,
+                     neutral: Any = None) -> Any:
     """Reduce a stacked swarm state (leading axis = replicas) to the join of
     all replicas, in log2(R) batched join steps.
 
-    `join_fn` must accept batched states (use `batched(...)` for joins written
-    single-instance).  `neutral` is the single-instance identity element used
-    to pad R up to a power of two (every model module exports a suitable
-    ``zero``/``empty``).
+    `join_fn` is either a *batched* callable (use `batched(...)` for joins
+    written single-instance) with an explicit `neutral`, or a
+    :class:`JoinSpec` / registered join name — then batching and the
+    identity element are derived from the registry and `neutral` may be
+    omitted.
     """
+    join_fn, neutral = _as_batched_join_and_neutral(join_fn, neutral)
     # profiler region: tree-reduce dispatches correlate by name with the
     # host-side gossip/merge spans in a captured trace (crdt_tpu.obs.trace)
     with jax.profiler.TraceAnnotation("crdt.tree_reduce_join"):
@@ -105,9 +143,11 @@ def tree_reduce_join(join_fn: Callable, state: Any, neutral: Any) -> Any:
         return jax.tree.map(lambda x: x[0], state)
 
 
-def converge(join_fn: Callable, state: Any, neutral: Any) -> Any:
+def converge(join_fn: Union[Callable, "JoinSpec", str], state: Any,
+             neutral: Any = None) -> Any:
     """Drive every replica to the swarm-wide least upper bound: the TPU-native
-    equivalent of running the reference's gossip loop to its fixpoint."""
+    equivalent of running the reference's gossip loop to its fixpoint.
+    Accepts the same registry-driven forms as :func:`tree_reduce_join`."""
     r = _leading_dim(state)
     top = tree_reduce_join(join_fn, state, neutral)
     return jax.tree.map(lambda t: jnp.broadcast_to(t[None], (r,) + t.shape), top)
@@ -136,12 +176,27 @@ def converge(join_fn: Callable, state: Any, neutral: Any) -> Any:
 @dataclasses.dataclass(frozen=True)
 class JoinSpec:
     """One registered lattice join: the function, an example-operand
-    factory (returns the (a, b) pair to trace with), and its claims."""
+    factory (returns the (a, b) pair to trace with), its claims, and —
+    new with the compositional algebra — enough metadata to drive the
+    whole framework from the registry alone:
+
+    * ``neutral`` builds the join's identity element (same avals as one
+      ``example()`` operand), so ``converge``/``tree_reduce_join`` and any
+      padding path derive their neutral from the registry;
+    * ``rand`` draws one random *reachable* state (np rng in, state out)
+      — the fuel of the registry-wide ACI law sweep;
+    * ``parts`` names the registered joins a composite was built from
+      (empty for leaves); crdtlint's CRDT104 checks metadata propagation
+      against it.
+    """
 
     name: str
     join: Callable
     example: Callable[[], Tuple[Any, Any]]
     structurally_commutative: bool = False
+    neutral: Optional[Callable[[], Any]] = None
+    rand: Optional[Callable[[Any], Any]] = None
+    parts: Tuple[str, ...] = ()
 
 
 _JOIN_REGISTRY: Dict[str, JoinSpec] = {}
@@ -149,12 +204,25 @@ _BUILTINS_REGISTERED = False
 
 
 def register_join(name: str, join_fn: Callable,
-                  example: Callable[[], Tuple[Any, Any]], *,
-                  structurally_commutative: bool = False) -> JoinSpec:
+                  example: Optional[Callable[[], Tuple[Any, Any]]] = None, *,
+                  structurally_commutative: bool = False,
+                  neutral: Optional[Callable[[], Any]] = None,
+                  rand: Optional[Callable[[Any], Any]] = None,
+                  parts: Tuple[str, ...] = ()) -> JoinSpec:
     """Register a lattice join for the static ACI/purity gate.  ``example``
-    builds a concrete (a, b) operand pair; only its avals are used."""
+    builds a concrete (a, b) operand pair; only its avals are used.  When
+    omitted it defaults to a pair of ``neutral`` elements (one of the two
+    must be given)."""
+    if example is None:
+        if neutral is None:
+            raise ValueError(
+                f"register_join({name!r}) needs an example factory or a "
+                "neutral to derive one from"
+            )
+        example = lambda: (neutral(), neutral())  # noqa: E731
     spec = JoinSpec(name=name, join=join_fn, example=example,
-                    structurally_commutative=structurally_commutative)
+                    structurally_commutative=structurally_commutative,
+                    neutral=neutral, rand=rand, parts=tuple(parts))
     _JOIN_REGISTRY[name] = spec
     return spec
 
@@ -185,38 +253,58 @@ def _register_builtin_joins() -> None:
         pncounter,
         rseq,
     )
+    from crdt_tpu.ops import randstate as rs
 
     register_join("gcounter", gcounter.join,
-                  lambda: (gcounter.zero(8), gcounter.zero(8)),
+                  neutral=lambda: gcounter.zero(8),
+                  rand=rs.rand_gcounter,
                   structurally_commutative=True)
     register_join("pncounter", pncounter.join,
-                  lambda: (pncounter.zero(8), pncounter.zero(8)),
+                  neutral=lambda: pncounter.zero(8),
+                  rand=rs.rand_pncounter,
                   structurally_commutative=True)
     register_join("lww", lww.join,
-                  lambda: (lww.zero(), lww.zero()))
+                  neutral=lww.zero, rand=rs.rand_lww)
     register_join("lww_packed", lww.join_packed,
-                  lambda: (lww.pack(lww.zero()), lww.pack(lww.zero())))
+                  neutral=lambda: lww.pack(lww.zero()),
+                  rand=rs.rand_lww_packed)
     register_join("mvregister", mvregister.join,
-                  lambda: (mvregister.zero(4), mvregister.zero(4)))
+                  neutral=lambda: mvregister.zero(4),
+                  rand=rs.rand_mvregister)
     register_join("token_plane", flags.plane_join,
-                  lambda: (flags.plane_zero(4), flags.plane_zero(4)),
+                  neutral=lambda: flags.plane_zero(4),
+                  rand=rs.rand_token_plane,
                   structurally_commutative=True)
     register_join("ew_flag", flags.ew_join,
-                  lambda: (flags.ew_zero(4), flags.ew_zero(4)),
+                  neutral=lambda: flags.ew_zero(4),
+                  rand=rs.rand_ew_flag,
                   structurally_commutative=True)
     register_join("dw_flag", flags.dw_join,
-                  lambda: (flags.dw_zero(4), flags.dw_zero(4)),
+                  neutral=lambda: flags.dw_zero(4),
+                  rand=rs.rand_dw_flag,
                   structurally_commutative=True)
     register_join("gset", gset.g_join,
-                  lambda: (gset.g_empty(16), gset.g_empty(16)))
+                  neutral=lambda: gset.g_empty(16),
+                  rand=rs.rand_gset)
     register_join("twopset", gset.tp_join,
-                  lambda: (gset.tp_empty(16), gset.tp_empty(16)))
+                  neutral=lambda: gset.tp_empty(16),
+                  rand=rs.rand_twopset)
     register_join("orset", orset.join,
-                  lambda: (orset.empty(16), orset.empty(16)))
+                  neutral=lambda: orset.empty(16),
+                  rand=rs.rand_orset)
     register_join("rseq", rseq.join,
-                  lambda: (rseq.empty(16), rseq.empty(16)))
+                  neutral=lambda: rseq.empty(16),
+                  rand=rs.rand_rseq)
     register_join("oplog", oplog.merge,
-                  lambda: (oplog.empty(32), oplog.empty(32)))
+                  neutral=lambda: oplog.empty(32),
+                  rand=rs.rand_oplog)
     register_join("compactlog", compactlog.merge,
-                  lambda: (compactlog.empty(32, 8, 4),
-                           compactlog.empty(32, 8, 4)))
+                  neutral=lambda: compactlog.empty(32, 8, 4),
+                  rand=rs.rand_compactlog)
+
+    # derived composite models (crdt_tpu.models.composite) register through
+    # the combinator layer (crdt_tpu.ops.algebra) — same late import as the
+    # leaf models to dodge the ops <-> models cycle
+    from crdt_tpu.models import composite
+
+    composite.register_builtin_composites()

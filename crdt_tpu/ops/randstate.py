@@ -1,0 +1,243 @@
+"""Randomized reachable-state generators for every registered join.
+
+Each generator takes a ``numpy.random.Generator`` and returns one lattice
+state drawn from the join's *reachable* state space — the space over which
+the ACI laws are required to hold.  They back ``JoinSpec.rand`` so the
+law sweep (tests/test_lattice_laws.py) runs registry-wide instead of over
+a hand-picked list, and composites (crdt_tpu.ops.algebra) derive theirs
+from their parts' generators.
+
+Two soundness rules keep independently drawn states mutually consistent
+(two replicas of the SAME system, not two unrelated systems):
+
+* **payload-from-identity** — wherever a row/cell carries an identity
+  (lww's (ts, rid), an op's (ts, rid, seq, key), an rseq path key), its
+  payload is a pure function of that identity.  Real replication gives
+  identical ops identical payloads; independent draws must too, or the
+  commutativity check fails on resolution ties that could never happen.
+* **capacity headroom** — sorted fixed-capacity lattices are filled to
+  at most ~capacity/3 so pairwise AND three-way law joins stay within
+  capacity (overflow drops keys, which is lossy, not a law violation).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from crdt_tpu.utils.constants import SENTINEL_PY
+
+
+def _i32(rng: np.random.Generator, lo: int, hi: int, shape=()):
+    return jnp.asarray(rng.integers(lo, hi, shape), jnp.int32)
+
+
+def rand_gcounter(rng, n_nodes: int = 8):
+    from crdt_tpu.models import gcounter
+
+    return gcounter.GCounter(counts=_i32(rng, 0, 100, (n_nodes,)))
+
+
+def rand_pncounter(rng, n_nodes: int = 8):
+    from crdt_tpu.models import pncounter
+
+    return pncounter.PNCounter(
+        pos=_i32(rng, 0, 100, (n_nodes,)),
+        neg=_i32(rng, 0, 100, (n_nodes,)),
+    )
+
+
+def _lww_payload(ts, rid):
+    # payload-from-identity: the value of write (ts, rid) is a fixed hash
+    return (ts * 131 + rid * 17) % 997
+
+
+def rand_lww(rng):
+    from crdt_tpu.models import lww
+
+    ts = int(rng.integers(0, 50))
+    rid = int(rng.integers(0, 8))
+    return lww.LWWRegister(
+        ts=jnp.asarray(ts, jnp.int32),
+        rid=jnp.asarray(rid, jnp.int32),
+        payload=jnp.asarray(_lww_payload(ts, rid), jnp.int32),
+    )
+
+
+def rand_lww_packed(rng):
+    from crdt_tpu.models import lww
+
+    return lww.pack(rand_lww(rng))
+
+
+def rand_mvregister(rng, n_writers: int = 4):
+    from crdt_tpu.models import mvregister
+
+    # per-writer cells: (seq, then elementwise max) is a lattice for ANY
+    # values >= the zero element's, so only the zero bounds matter:
+    # seq/obs >= -1, ts/payload >= 0
+    return mvregister.MVRegister(
+        seq=_i32(rng, -1, 5, (n_writers,)),
+        ts=_i32(rng, 0, 50, (n_writers,)),
+        payload=_i32(rng, 0, 100, (n_writers,)),
+        obs=_i32(rng, -1, 5, (n_writers, n_writers)),
+    )
+
+
+def rand_token_plane(rng, n_writers: int = 4):
+    from crdt_tpu.models import flags
+
+    return flags.TokenPlane(
+        tok=_i32(rng, -1, 5, (n_writers,)),
+        obs=_i32(rng, -1, 5, (n_writers, n_writers)),
+    )
+
+
+def rand_ew_flag(rng, n_writers: int = 4):
+    from crdt_tpu.models import flags
+
+    return flags.EWFlag(plane=rand_token_plane(rng, n_writers))
+
+
+def rand_dw_flag(rng, n_writers: int = 4):
+    from crdt_tpu.models import flags
+
+    return flags.DWFlag(
+        plane=rand_token_plane(rng, n_writers),
+        touched=jnp.asarray(bool(rng.integers(0, 2))),
+    )
+
+
+def _sorted_pad(elems, capacity: int):
+    """Sorted int32[capacity] column with SENTINEL tail padding."""
+    xs = sorted(elems) + [SENTINEL_PY] * (capacity - len(elems))
+    return jnp.asarray(xs, jnp.int32)
+
+
+def rand_gset(rng, capacity: int = 16, fill: int = 5):
+    from crdt_tpu.models import gset
+
+    elems = rng.choice(40, size=int(rng.integers(0, fill + 1)), replace=False)
+    return gset.GSet(elem=_sorted_pad([int(e) for e in elems], capacity))
+
+
+def rand_twopset(rng, capacity: int = 16, fill: int = 5):
+    from crdt_tpu.models import gset
+
+    elems = sorted(
+        int(e)
+        for e in rng.choice(40, size=int(rng.integers(0, fill + 1)),
+                            replace=False)
+    )
+    removed = [bool(rng.random() < 0.3) for _ in elems]
+    pad = [False] * (capacity - len(elems))
+    return gset.TwoPSet(
+        elem=_sorted_pad(elems, capacity),
+        removed=jnp.asarray(removed + pad, bool),
+    )
+
+
+def rand_orset(rng, capacity: int = 16, fill: int = 5):
+    from crdt_tpu.models import orset
+
+    s = orset.empty(capacity)
+    taken = set()
+    for _ in range(int(rng.integers(0, fill + 1))):
+        while True:
+            tag = (int(rng.integers(0, 6)), int(rng.integers(0, 3)),
+                   int(rng.integers(0, 50)))
+            if tag not in taken:
+                taken.add(tag)
+                break
+        s = orset.add(s, *tag)
+        if rng.random() < 0.3:
+            s = orset.remove(s, tag[0])
+    return s
+
+
+def rand_rseq(rng, capacity: int = 16, fill: int = 5):
+    from crdt_tpu.models import rseq
+
+    depth = rseq.DEPTH
+    rows = set()
+    for _ in range(int(rng.integers(0, fill + 1))):
+        rows.add(tuple(int(v) for v in rng.integers(0, 30, 4 * depth)))
+    rows = sorted(rows)  # lexicographic row order == the table's sort order
+    keys = np.full((capacity, 4 * depth), SENTINEL_PY, np.int64)
+    elem = np.zeros((capacity,), np.int64)
+    removed = np.zeros((capacity,), bool)
+    for i, row in enumerate(rows):
+        keys[i] = row
+        # payload-from-identity: the element at a path key is a fixed hash
+        elem[i] = sum((j + 3) * v for j, v in enumerate(row)) % 1009
+        removed[i] = bool(rng.random() < 0.3)
+    return rseq.RSeq(
+        keys=jnp.asarray(keys, jnp.int32),
+        elem=jnp.asarray(elem, jnp.int32),
+        removed=jnp.asarray(removed),
+    )
+
+
+def _rand_op_rows(rng, n: int, n_keys: int, n_rids: int):
+    rows = set()
+    while len(rows) < n:
+        rows.add((
+            int(rng.integers(0, 40)),
+            int(rng.integers(0, n_rids)),
+            int(rng.integers(0, 20)),
+            int(rng.integers(0, n_keys)),
+        ))
+    rows = sorted(rows)
+    # payload-from-identity: val / payload / is_num are fixed hashes of
+    # the op identity (ts, rid, seq, key)
+    ident = [ts * 7 + rid * 5 + seq * 3 + key for ts, rid, seq, key in rows]
+    return {
+        "ts": jnp.asarray([r[0] for r in rows], jnp.int32),
+        "rid": jnp.asarray([r[1] for r in rows], jnp.int32),
+        "seq": jnp.asarray([r[2] for r in rows], jnp.int32),
+        "key": jnp.asarray([r[3] for r in rows], jnp.int32),
+        "val": jnp.asarray([h % 41 - 20 for h in ident], jnp.int32),
+        "payload": jnp.asarray([h % 499 for h in ident], jnp.int32),
+        "is_num": jnp.asarray([h % 5 < 4 for h in ident], bool),
+    }
+
+
+def rand_oplog(rng, capacity: int = 32, fill: int = 10, n_keys: int = 6,
+               n_rids: int = 3):
+    from crdt_tpu.models import oplog
+
+    n = int(rng.integers(0, fill + 1))
+    if n == 0:
+        return oplog.empty(capacity)
+    return oplog.from_ops(capacity, _rand_op_rows(rng, n, n_keys, n_rids))
+
+
+def rand_compactlog(rng, capacity: int = 32, n_keys: int = 8,
+                    n_writers: int = 4):
+    from crdt_tpu.models import compactlog
+
+    # frontier = -1 everywhere (nothing folded): merge's adopt-the-larger
+    # rule degenerates to a plain tail union, which is where the law sweep
+    # can run on independently drawn states (non-trivial frontiers require
+    # the swarm's chain-ordering protocol to be law-abiding)
+    return compactlog.fresh(
+        rand_oplog(rng, capacity=capacity, n_keys=n_keys, n_rids=n_writers),
+        n_keys, n_writers,
+    )
+
+
+BUILTIN_RAND = {
+    "gcounter": rand_gcounter,
+    "pncounter": rand_pncounter,
+    "lww": rand_lww,
+    "lww_packed": rand_lww_packed,
+    "mvregister": rand_mvregister,
+    "token_plane": rand_token_plane,
+    "ew_flag": rand_ew_flag,
+    "dw_flag": rand_dw_flag,
+    "gset": rand_gset,
+    "twopset": rand_twopset,
+    "orset": rand_orset,
+    "rseq": rand_rseq,
+    "oplog": rand_oplog,
+    "compactlog": rand_compactlog,
+}

@@ -61,7 +61,7 @@ def _interner_load(strings: list, interner) -> None:
 
 
 def save_node(path: str, node, set_node=None, seq_node=None,
-              map_node=None) -> None:
+              map_node=None, composite_node=None) -> None:
     """Snapshot a ReplicaNode: op-tensor columns + interner tables + the
     raw command map (the gossip-serving source of truth).  ``set_node``
     (a crdt_tpu.api.setnode.SetNode) adds the daemon's set-lattice section
@@ -69,7 +69,10 @@ def save_node(path: str, node, set_node=None, seq_node=None,
     rebuilt on restore; ``seq_node`` (crdt_tpu.api.seqnode.SeqNode) adds
     the sequence-lattice section the same way; ``map_node``
     (crdt_tpu.api.mapnode.MapNode) adds the map-lattice section (op
-    records + reset epochs)."""
+    records + reset epochs); ``composite_node`` (crdt_tpu.api
+    .compositenode.CompositeNode) adds the algebra composite's state dump
+    — its snapshot IS its wire payload, so restore revalidates it like a
+    gossip body."""
     p = pathlib.Path(path)
     p.mkdir(parents=True, exist_ok=True)
     if set_node is not None:
@@ -78,6 +81,9 @@ def save_node(path: str, node, set_node=None, seq_node=None,
         (p / "seq.json").write_text(json.dumps(seq_node.to_snapshot()))
     if map_node is not None:
         (p / "map.json").write_text(json.dumps(map_node.to_snapshot()))
+    if composite_node is not None:
+        (p / "composite.json").write_text(
+            json.dumps(composite_node.to_snapshot()))
     cols = {
         name: np.asarray(getattr(node.log, name))
         for name in ("ts", "rid", "seq", "key", "val", "payload", "is_num")
@@ -101,7 +107,8 @@ def save_node(path: str, node, set_node=None, seq_node=None,
 
 
 def restore_node(path: str, node, allow_rid_change: bool = False,
-                 set_node=None, seq_node=None, map_node=None) -> None:
+                 set_node=None, seq_node=None, map_node=None,
+                 composite_node=None) -> None:
     """Restore a snapshot into a freshly-constructed ReplicaNode.
 
     ``allow_rid_change=True`` is the boot-incarnation path (see module
@@ -148,6 +155,12 @@ def restore_node(path: str, node, allow_rid_change: bool = False,
         seq_node.from_snapshot(json.loads((p / "seq.json").read_text()))
     if map_node is not None and (p / "map.json").exists():
         map_node.from_snapshot(json.loads((p / "map.json").read_text()))
+    if composite_node is not None and (p / "composite.json").exists():
+        # from_snapshot validates like a wire payload: a flipped-bit
+        # composite.json raises here → load_latest_node quarantines the
+        # whole generation and falls back, same as any torn section
+        composite_node.from_snapshot(
+            json.loads((p / "composite.json").read_text()))
 
 
 # ---- crash-safe versioned snapshots + boot incarnations ---------------------
@@ -231,7 +244,7 @@ def _quarantine_snap(rootp: pathlib.Path, snap: pathlib.Path) -> None:
 
 
 def save_node_atomic(root: str, node, set_node=None, seq_node=None,
-                     map_node=None) -> str:
+                     map_node=None, composite_node=None) -> str:
     """Snapshot ``node`` into a fresh versioned directory under ``root``
     and atomically repoint LATEST at it — a SIGKILL at ANY instant leaves
     either the previous complete snapshot or the new complete snapshot as
@@ -254,7 +267,7 @@ def save_node_atomic(root: str, node, set_node=None, seq_node=None,
     shutil.rmtree(staging, ignore_errors=True)  # orphan from a past crash
     with node._lock:
         save_node(str(staging), node, set_node=set_node, seq_node=seq_node,
-                  map_node=map_node)
+                  map_node=map_node, composite_node=composite_node)
     # integrity manifest INSIDE the staging dir: the rename publishes the
     # snapshot and its checksums as one unit (a snapshot without a complete
     # manifest can only be a legacy one)
@@ -272,7 +285,8 @@ def save_node_atomic(root: str, node, set_node=None, seq_node=None,
 
 
 def load_latest_node(root: str, node, allow_rid_change: bool = True,
-                     set_node=None, seq_node=None, map_node=None) -> bool:
+                     set_node=None, seq_node=None, map_node=None,
+                     composite_node=None) -> bool:
     """Restore the newest intact snapshot under ``root`` into ``node``;
     False when none restores (fresh boot).
 
@@ -308,7 +322,8 @@ def load_latest_node(root: str, node, allow_rid_change: bool = True,
                 restore_node(str(snap), node,
                              allow_rid_change=allow_rid_change,
                              set_node=set_node, seq_node=seq_node,
-                             map_node=map_node)
+                             map_node=map_node,
+                             composite_node=composite_node)
             except Exception as e:  # noqa: BLE001 — quarantined loudly below
                 err = f"restore failed: {type(e).__name__}: {e}"
         if err is not None:

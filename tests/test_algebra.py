@@ -1,0 +1,222 @@
+"""Combinator-layer tests: the compositional CRDT algebra.
+
+The registry-wide ACI sweep (tests/test_lattice_laws.py) already law-checks
+every registered composite; this file pins what the sweep can't see —
+combinator *semantics* (dominance, reset, map parity), metadata
+propagation, the act laws of the semidirect construction, and the
+bit-equivalence of ``mapof(pncounter)`` against the bespoke ``ormap``
+merge on randomized op traces (the acceptance criterion of the algebra
+ISSUE)."""
+import numpy as np
+import pytest
+
+from crdt_tpu.models import (
+    composite,
+    gcounter,
+    lww,
+    mvregister,
+    ormap,
+    pncounter,
+)
+from crdt_tpu.models.composite import Pair
+from crdt_tpu.ops import algebra, joins
+from crdt_tpu.ops import randstate as rs
+from tests.helpers import tree_equal
+
+
+def _spec(name):
+    return joins.registered_joins()[name]
+
+
+# ------------------------------------------------------------- metadata
+
+
+def test_composites_registered_with_propagated_metadata():
+    mapof_pn = _spec("mapof(pncounter)")
+    assert mapof_pn.parts == ("pncounter",)
+    assert mapof_pn.structurally_commutative  # inner claims True
+
+    lex = _spec("lexicographic(lww,mvregister)")
+    assert lex.parts == ("lww", "mvregister")
+    assert not lex.structurally_commutative  # selects: always False
+
+    semi = _spec("semidirect(gcounter,pncounter)")
+    assert semi.parts == ("gcounter", "pncounter")
+    assert not semi.structurally_commutative  # action: always False
+
+    prod = _spec("product(gcounter,pncounter)")
+    assert prod.parts == ("gcounter", "pncounter")
+    assert prod.structurally_commutative  # AND of two True parts
+
+
+def test_product_claim_is_and_of_parts():
+    """product over a non-commutative-claiming part claims False."""
+    name = "product(gcounter,lww)"
+    try:
+        spec = algebra.product("gcounter", "lww")
+        assert spec.name == name
+        assert not spec.structurally_commutative
+        assert spec.parts == ("gcounter", "lww")
+        # derived neutral and rand came from the parts
+        n = spec.neutral()
+        assert tree_equal(n.fst, gcounter.zero(8))
+        assert tree_equal(n.snd, lww.zero())
+        assert tree_equal(spec.join(n, n), n)
+    finally:
+        joins._JOIN_REGISTRY.pop(name, None)
+
+
+def test_resolve_unknown_part_raises():
+    with pytest.raises(KeyError):
+        algebra.product("pncounter", "no_such_lattice")
+
+
+# ----------------------------------------------------- mapof <-> ormap
+
+
+def _rand_trace(rng, n_ops, n_keys, n_writers):
+    ops = []
+    for _ in range(n_ops):
+        key = int(rng.integers(0, n_keys))
+        writer = int(rng.integers(0, n_writers))
+        if rng.random() < 0.25:
+            ops.append(("rem", key, writer, 0))
+        else:
+            ops.append(("upd", key, writer, int(rng.integers(-9, 10))))
+    return ops
+
+
+def _apply_trace(state, ops):
+    for op, key, writer, delta in ops:
+        if op == "rem":
+            state = ormap.remove(state, key, writer)
+        else:
+            state = ormap.update(
+                state, key, writer,
+                lambda v, _w=writer, _d=delta: pncounter.add(v, _w, _d))
+    return state
+
+
+def test_mapof_pncounter_matches_bespoke_ormap_on_random_traces():
+    """The composed join must be bit-equivalent to the bespoke ormap merge
+    (`ormap.joiner`) on states built from randomized op traces, and the
+    materialized view (contains + per-key counter values) must agree."""
+    spec = _spec("mapof(pncounter)")
+    n_keys, n_writers = 4, 3
+    bespoke = ormap.joiner(pncounter.join)  # elementwise: batches as-is
+    rng = np.random.default_rng(42)
+    for _ in range(10):
+        empty = ormap.empty(n_keys, n_writers, pncounter.zero(n_writers))
+        a = _apply_trace(empty, _rand_trace(rng, 12, n_keys, n_writers))
+        b = _apply_trace(empty, _rand_trace(rng, 12, n_keys, n_writers))
+        got = spec.join(a, b)
+        want = bespoke(a, b)
+        assert tree_equal(got, want), "composed join != bespoke ormap merge"
+        assert np.array_equal(
+            np.asarray(ormap.contains(got)), np.asarray(ormap.contains(want)))
+        assert np.array_equal(
+            np.asarray(pncounter.value(got.values)),
+            np.asarray(pncounter.value(want.values)))
+
+
+def test_mapof_join_is_shape_generic():
+    """The registered join serves ANY key/writer universe, not just the
+    example's — the servable CompositeNode relies on this as it grows."""
+    spec = _spec("mapof(pncounter)")
+    n_keys, n_writers = 6, 2
+    empty = ormap.empty(n_keys, n_writers, pncounter.zero(n_writers))
+    a = ormap.update(empty, 5, 1, lambda v: pncounter.add(v, 1, 7))
+    b = ormap.update(empty, 0, 0, lambda v: pncounter.add(v, 0, -2))
+    m = spec.join(a, b)
+    assert list(np.asarray(ormap.contains(m))) == [
+        True, False, False, False, False, True]
+    assert list(np.asarray(pncounter.value(m.values))) == [-2, 0, 0, 0, 0, 7]
+
+
+# ------------------------------------------------------- lexicographic
+
+
+def test_lexicographic_dominance_and_tiebreak():
+    reg_hi = lww.write(lww.zero(), ts=20, rid=1, payload=7)
+    reg_lo = lww.write(lww.zero(), ts=10, rid=2, payload=8)
+    mv_a = mvregister.write(mvregister.zero(4), writer=0, ts=20, payload=70)
+    mv_b = mvregister.write(mvregister.zero(4), writer=1, ts=10, payload=80)
+    spec = _spec("lexicographic(lww,mvregister)")
+
+    # strictly greater rank takes BOTH parts wholesale — the losing side's
+    # mv-plane (siblings of a superseded era) does not leak through
+    out = spec.join(Pair(fst=reg_hi, snd=mv_a), Pair(fst=reg_lo, snd=mv_b))
+    assert tree_equal(out.fst, reg_hi)
+    assert tree_equal(out.snd, mv_a)
+    # ... and symmetrically
+    out2 = spec.join(Pair(fst=reg_lo, snd=mv_b), Pair(fst=reg_hi, snd=mv_a))
+    assert tree_equal(out2, out)
+
+    # equal rank (identical winning write): the b-parts join — concurrent
+    # siblings of the same era surface together
+    tie = spec.join(Pair(fst=reg_hi, snd=mv_a), Pair(fst=reg_hi, snd=mv_b))
+    assert tree_equal(tie.fst, reg_hi)
+    assert tree_equal(tie.snd, mvregister.join(mv_a, mv_b))
+    assert int(mvregister.n_siblings(tie.snd)) == 2
+
+
+# ----------------------------------------------------------- semidirect
+
+
+def test_semidirect_epoch_reset_counter():
+    spec = _spec("semidirect(gcounter,pncounter)")
+    zero = spec.neutral()
+    # replica A counts 5 in epoch 0; replica B bumps the epoch then counts 3
+    a = composite.epoch_add(zero, node=0, amount=5)
+    b = composite.epoch_add(composite.epoch_bump(zero, node=1), node=1,
+                            amount=3)
+    merged = spec.join(a, b)
+    # A's epoch-0 contribution was transported into epoch 1 => reset
+    assert int(composite.epoch_value(merged)) == 3
+    # same-epoch contributions keep merging normally
+    c = composite.epoch_add(merged, node=0, amount=4)
+    assert int(composite.epoch_value(spec.join(merged, c))) == 7
+    # a stale replica that never saw the bump keeps being reset on merge
+    assert int(composite.epoch_value(spec.join(c, a))) == 7
+
+
+def test_semidirect_act_laws():
+    """The three laws semidirect requires of ``act`` (algebra docstring):
+    identity, composition along monotone frame chains, join-homomorphism."""
+    rng = np.random.default_rng(9)
+    act = composite.reset_act
+    for _ in range(20):
+        f1 = rs.rand_gcounter(rng)
+        f2 = gcounter.join(f1, rs.rand_gcounter(rng))   # f1 <= f2
+        f3 = gcounter.join(f2, rs.rand_gcounter(rng))   # f2 <= f3
+        b1, b2 = rs.rand_pncounter(rng), rs.rand_pncounter(rng)
+        assert tree_equal(act(f1, f1, b1), b1), "identity"
+        assert tree_equal(
+            act(f3, f2, act(f2, f1, b1)), act(f3, f1, b1)), "composition"
+        assert tree_equal(
+            act(f3, f1, pncounter.join(b1, b2)),
+            pncounter.join(act(f3, f1, b1), act(f3, f1, b2)),
+        ), "join-homomorphism"
+
+
+# ----------------------------------------------- registry-driven driving
+
+
+def test_converge_composite_from_registry():
+    """A composite converges a stacked swarm straight from the registry —
+    no caller-threaded neutral, no bespoke batched join."""
+    spec = _spec("mapof(pncounter)")
+    rng = np.random.default_rng(3)
+    states = [spec.rand(rng) for _ in range(5)]
+    import jax
+
+    swarm = jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                         *states)
+    out = joins.converge("mapof(pncounter)", swarm)
+    # every replica landed on the same least upper bound
+    first = jax.tree.map(lambda x: x[0], out)
+    for i in range(1, 5):
+        assert tree_equal(jax.tree.map(lambda x, _i=i: x[_i], out), first)
+    # and the LUB dominates every input (join absorbs each state)
+    for s in states:
+        assert tree_equal(spec.join(first, s), first)

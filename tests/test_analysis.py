@@ -190,11 +190,24 @@ def _bad_registry():
     def asymmetric(a, b):
         return a  # trivially non-symmetric under operand swap
 
+    def select_max(a, b):
+        return jnp.where(a > b, a, b)  # extensionally max, asymmetric jaxpr
+
+    def symmetric(a, b):
+        return jnp.maximum(a, b)
+
     return {
         "impure": JoinSpec("impure", impure, example),
         "not_closed": JoinSpec("not_closed", not_closed, example),
         "asymmetric": JoinSpec("asymmetric", asymmetric, example,
                                structurally_commutative=True),
+        # an honestly-registered select join (claims False): clean ...
+        "select_leaf": JoinSpec("select_leaf", select_max, example),
+        # ... but a composite claiming commutativity OVER it must flag
+        # CRDT104 even though its own jaxpr (pure maximum) passes CRDT103
+        "bad_composite": JoinSpec("bad_composite", symmetric, example,
+                                  structurally_commutative=True,
+                                  parts=("select_leaf", "select_leaf")),
     }
 
 
@@ -209,6 +222,7 @@ def test_jaxpr_checks_catch_planted_defects(monkeypatch):
         "impure": "CRDT101",
         "not_closed": "CRDT102",
         "asymmetric": "CRDT103",
+        "bad_composite": "CRDT104",
     }
 
 
@@ -223,8 +237,18 @@ def test_real_registry_is_clean_and_complete():
         "gcounter", "pncounter", "lww", "lww_packed", "mvregister",
         "token_plane", "ew_flag", "dw_flag", "gset", "twopset",
         "orset", "rseq", "oplog", "compactlog",
+        # derived composites (crdt_tpu.models.composite): full citizens of
+        # the static gate — CRDT101-103 on the composed jaxpr, CRDT104 on
+        # metadata propagation
+        "mapof(pncounter)", "lexicographic(lww,mvregister)",
+        "semidirect(gcounter,pncounter)", "product(gcounter,pncounter)",
     }
     assert expected <= set(registry)
+    # every registration now carries neutral + rand: the registry is
+    # sufficient to drive converge() and the ACI law sweep on its own
+    for name, spec in registry.items():
+        assert spec.neutral is not None, name
+        assert spec.rand is not None, name
     assert jaxpr_checks.check_registered_joins(analysis.repo_root()) == []
 
 

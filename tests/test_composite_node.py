@@ -1,0 +1,321 @@
+"""CompositeNode: the served ``mapof(pncounter)`` composite.
+
+What's pinned here: local op semantics (upd/rem/observed-remove), the
+state-based wire (decode validation against nemesis corruption, foreign
+coordinate-space alignment), the one-dispatch-per-round fused fold
+(``merge_dispatches``), convergence via fingerprints, and the
+snapshot-as-wire-payload restore path — plus the NodeHost serving stack
+(HTTP routes, agent pulls, fused rounds, checkpoint restore)."""
+import threading
+
+import pytest
+
+from crdt_tpu.api.compositenode import CompositeNode
+
+
+def _pull(dst, src):
+    """One state-based pull: dst absorbs src's full dump."""
+    return dst.receive(src.gossip_payload())
+
+
+# ------------------------------------------------------------- local ops
+
+
+def test_upd_rem_readd_semantics():
+    n = CompositeNode(rid=0)
+    assert n.upd("x", 5) == 5
+    assert n.upd("x", -2) == 3
+    assert n.upd("y", 7) == 7
+    assert n.items() == {"x": 3, "y": 7}
+    assert n.value("x") == 3
+    assert n.rem("x") is True
+    assert n.items() == {"y": 7}
+    assert n.value("x") is None
+    # removing an absent / already-removed key mints nothing
+    assert n.rem("x") is False
+    assert n.rem("never-seen") is False
+    # a re-add drops a fresh token that the old observation doesn't cover;
+    # the PN planes survive removal (counter semantics: remove hides the
+    # key, it doesn't zero history)
+    assert n.upd("x", 1) == 4
+    assert n.items() == {"x": 4, "y": 7}
+
+
+def test_down_node_refuses_ops():
+    n = CompositeNode(rid=0)
+    n.upd("x", 1)
+    n.set_alive(False)
+    assert not n.ping()
+    assert n.upd("x", 1) is None
+    assert n.rem("x") is None
+    assert n.items() is None
+    assert n.gossip_payload() is None
+    n.set_alive(True)
+    assert n.items() == {"x": 1}
+
+
+def test_capacity_growth_past_initial():
+    n = CompositeNode(rid=0, n_keys=2, n_writers=2)
+    for i in range(9):
+        n.upd(f"k{i}", i)
+    assert n.items() == {f"k{i}": i for i in range(9)}
+    # writer growth comes from foreign rids arriving on the wire
+    peers = [CompositeNode(rid=r) for r in range(3, 8)]
+    for p in peers:
+        p.upd("shared", 1)
+        _pull(n, p)
+    assert n.items()["shared"] == 5
+
+
+# ------------------------------------------------------ wire validation
+
+
+def test_decode_rejects_nemesis_corruption():
+    n = CompositeNode(rid=0)
+    n.upd("x", 1)
+    good = n.gossip_payload()
+
+    # the FaultyTransport corrupt fault: first non-dunder section poisoned
+    # + marker added (faults/transport.py) — both independently fatal
+    poisoned = dict(good)
+    poisoned["keys"] = "corrupted-by-nemesis"
+    poisoned["__nemesis_corrupt__"] = 1
+    with pytest.raises(ValueError):
+        CompositeNode.decode(poisoned)
+    marker_only = dict(good)
+    marker_only["__nemesis_corrupt__"] = 1
+    with pytest.raises(ValueError):
+        CompositeNode.decode(marker_only)
+    keys_only = dict(good)
+    keys_only["keys"] = "corrupted-by-nemesis"
+    with pytest.raises(ValueError):
+        CompositeNode.decode(keys_only)
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda p: 42,                                     # not an object
+    lambda p: {**p, "writers": ["zero"]},             # non-int rids
+    lambda p: {**p, "keys": p["keys"] * 2},           # duplicate keys
+    lambda p: {**p, "tok": [[1, 2, 3]]},              # plane shape mismatch
+    lambda p: {**p, "obs": p["tok"]},                 # missing writer axis
+    lambda p: {**p, "pos": "corrupted-by-nemesis"},   # poisoned plane
+    lambda p: {k: v for k, v in p.items() if k != "neg"},  # plane dropped
+])
+def test_decode_rejects_malformed_payloads(mutate):
+    n = CompositeNode(rid=0)
+    n.upd("x", 1)
+    with pytest.raises(ValueError):
+        CompositeNode.decode(mutate(n.gossip_payload()))
+
+
+def test_empty_payload_roundtrips():
+    a, b = CompositeNode(rid=0), CompositeNode(rid=1)
+    assert _pull(a, b) == 0  # nothing to learn, and nothing blows up
+    assert a.items() == {}
+
+
+# -------------------------------------------------- merge + convergence
+
+
+def test_merge_decoded_is_one_dispatch_for_k_payloads():
+    """The PR-2 fused-ingest discipline: folding k peer payloads costs the
+    same single jitted dispatch as folding one."""
+    n = CompositeNode(rid=0)
+    n.upd("x", 1)
+    payloads = []
+    for r in range(1, 6):
+        p = CompositeNode(rid=r)
+        p.upd("x", 1)
+        p.upd(f"only-{r}", r)
+        payloads.append(CompositeNode.decode(p.gossip_payload()))
+    before = n.merge_dispatches
+    assert n.merge_decoded(payloads) == 1
+    assert n.merge_dispatches == before + 1
+    assert int(n.metrics.registry.counter_value(
+        "composite_merge_dispatches")) == 1
+    assert n.items()["x"] == 6
+    assert n.items()["only-3"] == 3
+
+
+def test_two_node_convergence_and_idempotence():
+    a, b = CompositeNode(rid=0), CompositeNode(rid=9)
+    a.upd("x", 5)
+    a.upd("z", 1)
+    b.upd("x", -2)
+    b.upd("y", 7)
+    # intern orders differ (a: x,z then y; b: x,y then z) — alignment by
+    # key string / writer rid, not by slot index
+    assert _pull(a, b) == 1
+    assert _pull(b, a) == 1
+    assert a.items() == b.items() == {"x": 3, "y": 7, "z": 1}
+    assert a.fingerprint() == b.fingerprint()
+    # idempotence on the wire: replaying the same payload is a no-op
+    assert _pull(a, b) == 0
+    assert a.fingerprint() == b.fingerprint()
+
+
+def test_observed_remove_across_the_wire():
+    a, b = CompositeNode(rid=0), CompositeNode(rid=1)
+    a.upd("x", 4)
+    _pull(b, a)                      # b observes a's token
+    assert b.rem("x") is True
+    a.upd("x", 2)                    # concurrent re-add: fresh token
+    _pull(a, b)
+    _pull(b, a)
+    # the remove killed the observed token; the concurrent add survives
+    assert a.items() == b.items() == {"x": 6}
+    # a remove that HAS observed everything hides the key on both sides
+    assert a.rem("x") is True
+    _pull(b, a)
+    assert a.items() == b.items() == {}
+    assert a.fingerprint() == b.fingerprint()
+
+
+def test_three_node_gossip_converges():
+    nodes = [CompositeNode(rid=r) for r in (2, 5, 11)]
+    nodes[0].upd("a", 1)
+    nodes[1].upd("a", 10)
+    nodes[1].rem("a")
+    nodes[2].upd("b", -3)
+    for _ in range(2):               # two full rings reach everyone
+        for i, src in enumerate(nodes):
+            _pull(nodes[(i + 1) % 3], src)
+    fps = [n.fingerprint() for n in nodes]
+    assert fps[0] == fps[1] == fps[2]
+    # node 1's remove only observed its own local state at remove time;
+    # node 0's token was not yet seen there, so "a" survives
+    assert nodes[0].items() == {"a": 11, "b": -3}
+
+
+# -------------------------------------------------------------- snapshot
+
+
+def test_snapshot_roundtrip():
+    n = CompositeNode(rid=3)
+    n.upd("x", 5)
+    n.upd("y", -1)
+    n.rem("y")
+    snap = n.to_snapshot()
+    fresh = CompositeNode(rid=3)
+    fresh.from_snapshot(snap)
+    assert fresh.fingerprint() == n.fingerprint()
+    assert fresh.items() == {"x": 5}
+    # restored node keeps converging normally
+    peer = CompositeNode(rid=4)
+    peer.upd("x", 1)
+    _pull(fresh, peer)
+    assert fresh.items() == {"x": 6}
+
+
+def test_corrupt_snapshot_fails_restore():
+    """from_snapshot validates like a wire payload — a flipped-bit
+    composite.json raises instead of resurrecting garbage (checkpoint
+    loader then quarantines the snapshot generation)."""
+    n = CompositeNode(rid=0)
+    n.upd("x", 1)
+    snap = n.to_snapshot()
+    snap["tok"] = "corrupted"
+    with pytest.raises(ValueError):
+        CompositeNode(rid=0).from_snapshot(snap)
+
+
+# ------------------------------------------------- NodeHost serving stack
+
+
+def _serve(*hosts):
+    from crdt_tpu.api.net import RemotePeer
+
+    for h in hosts:
+        h.agent.peers = [RemotePeer(o.url) for o in hosts if o is not h]
+        t = threading.Thread(target=h._server.serve_forever, daemon=True)
+        t.start()
+
+
+def _shutdown(*hosts):
+    for h in hosts:
+        h._server.shutdown()
+        h._server.server_close()
+
+
+def test_nodehost_http_surface_and_pull():
+    import json
+    import urllib.request
+
+    from crdt_tpu.api.net import NodeHost
+
+    a, b = NodeHost(rid=0, peers=[]), NodeHost(rid=1, peers=[])
+    _serve(a, b)
+    try:
+        def post(url, path, body):
+            req = urllib.request.Request(
+                url + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req, timeout=5) as res:
+                return json.loads(res.read())
+
+        assert post(a.url, "/composite/upd",
+                    {"key": "x", "delta": 5}) == {"value": 5}
+        assert post(b.url, "/composite/upd",
+                    {"key": "x", "delta": -2}) == {"value": -2}
+        assert post(b.url, "/composite/upd",
+                    {"key": "y", "delta": 7}) == {"value": 7}
+        # gossip_once carries the composite alongside KV/set/seq/map
+        a.agent.gossip_once()
+        b.agent.gossip_once()
+        want = {"x": 3, "y": 7}
+        assert a.composite_node.items() == b.composite_node.items() == want
+        with urllib.request.urlopen(a.url + "/composite", timeout=5) as res:
+            assert json.loads(res.read()) == {"items": want}
+        # observed-remove over HTTP, then the admin drive surface
+        assert post(a.url, "/composite/rem", {"key": "y"}) == {
+            "removed": True}
+        assert post(b.url, "/admin/composite_pull", {}) == {"pulled": True}
+        assert b.composite_node.items() == {"x": 3}
+        # /metrics exposes the composite health gauges
+        with urllib.request.urlopen(a.url + "/metrics", timeout=5) as res:
+            body = res.read().decode()
+        assert "composite_keys" in body
+        assert "composite_merge_dispatches" in body
+    finally:
+        _shutdown(a, b)
+
+
+def test_fused_round_folds_composite_in_one_dispatch():
+    """config.fuse_pull_k > 1: the composite leg of a fused round fetches
+    every responding peer's state and folds ALL of them in one dispatch."""
+    from crdt_tpu.api.net import NodeHost
+    from crdt_tpu.utils.config import ClusterConfig
+
+    cfg = ClusterConfig(fuse_pull_k=2)
+    hosts = [NodeHost(rid=r, peers=[], config=cfg) for r in range(3)]
+    _serve(*hosts)
+    try:
+        for i, h in enumerate(hosts):
+            h.composite_node.upd("x", i + 1)
+        before = hosts[0].composite_node.merge_dispatches
+        hosts[0].agent.gossip_once()
+        assert hosts[0].composite_node.merge_dispatches == before + 1
+        assert hosts[0].composite_node.items() == {"x": 6}
+    finally:
+        _shutdown(*hosts)
+
+
+def test_nodehost_checkpoint_roundtrips_composite(tmp_path):
+    from crdt_tpu.api.net import NodeHost
+
+    d = str(tmp_path / "ckpt")
+    a = NodeHost(rid=0, peers=[], checkpoint_dir=d)
+    a.composite_node.upd("x", 5)
+    a.composite_node.upd("y", 1)
+    a.composite_node.rem("y")
+    assert a.checkpoint_now() is not None
+    fp = a.composite_node.fingerprint()
+    a._server.server_close()
+
+    b = NodeHost(rid=0, peers=[], checkpoint_dir=d)
+    try:
+        assert b.restored
+        assert b.composite_node.fingerprint() == fp
+        assert b.composite_node.items() == {"x": 5}
+    finally:
+        b._server.server_close()

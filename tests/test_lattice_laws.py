@@ -1,57 +1,73 @@
 """Property tests of the join laws every CRDT lattice must satisfy:
 commutativity, associativity, idempotence, and identity (SURVEY.md §4's
 mandate — the reference has no tests at all; convergence there was eyeballed
-via GET /data polling, /root/reference/main.go:273-314)."""
+via GET /data polling, /root/reference/main.go:273-314).
+
+The ACI sweep parametrizes over ``registered_joins()`` — every join the
+package exports, leaves AND algebra-derived composites, is law-checked on
+randomized reachable states drawn by its own ``JoinSpec.rand`` generator.
+Registering a join without ``rand``/``neutral`` fails here loudly, which
+is the point: the registry is the single source of truth."""
 import zlib
 
 import numpy as np
 import pytest
 
 from crdt_tpu.models import gcounter, lww, oplog, orset, pncounter
+from crdt_tpu.ops import joins
 from tests import helpers
 from tests.helpers import tree_equal
 
 N_TRIALS = 20
+# the registry sweep covers ~18 lattices x 7 joins per trial; a lighter
+# trial count keeps tier-1 wall clock flat while every join still sees
+# dozens of randomized states
+N_REGISTRY_TRIALS = 12
 
 
-def _cases():
-    return [
-        (
-            "gcounter",
-            gcounter.join,
-            lambda rng: helpers.rand_gcounter(rng),
-            lambda: gcounter.zero(8),
-        ),
-        (
-            "pncounter",
-            pncounter.join,
-            lambda rng: helpers.rand_pncounter(rng),
-            lambda: pncounter.zero(8),
-        ),
-        (
-            "lww",
-            lww.join,
-            lambda rng: helpers.rand_lww(rng),
-            lambda: lww.zero(),
-        ),
-        (
-            "orset",
-            orset.join,
-            lambda rng: helpers.rand_orset(rng),
-            lambda: orset.empty(32),
-        ),
-    ]
+def _registered_names():
+    return sorted(joins.registered_joins())
 
 
-@pytest.mark.parametrize("name,join,gen,zero", _cases(), ids=lambda c: c if isinstance(c, str) else "")
-def test_join_laws(name, join, gen, zero):
+@pytest.mark.parametrize("name", _registered_names())
+def test_registered_join_laws(name):
+    """ACI + identity on every registered join — the runtime half of the
+    static gate (crdtlint CRDT101-104), driven entirely from the registry:
+    states come from ``spec.rand``, the identity from ``spec.neutral``."""
+    spec = joins.registered_joins()[name]
+    assert spec.rand is not None, f"{name} registered no rand generator"
+    assert spec.neutral is not None, f"{name} registered no neutral"
+    join = spec.join
     rng = np.random.default_rng(zlib.crc32(name.encode()))
-    for _ in range(N_TRIALS):
-        a, b, c = gen(rng), gen(rng), gen(rng)
+    for _ in range(N_REGISTRY_TRIALS):
+        a, b, c = spec.rand(rng), spec.rand(rng), spec.rand(rng)
         assert tree_equal(join(a, b), join(b, a)), "commutativity"
         assert tree_equal(join(join(a, b), c), join(a, join(b, c))), "associativity"
         assert tree_equal(join(a, a), a), "idempotence"
-        assert tree_equal(join(a, zero()), a), "identity"
+        assert tree_equal(join(a, spec.neutral()), a), "identity"
+
+
+def test_registry_driven_converge():
+    """converge()/tree_reduce_join accept a registered name: batching and
+    the neutral pad element both come from the registry."""
+    rng = np.random.default_rng(5)
+    spec = joins.registered_joins()["pncounter"]
+    states = [spec.rand(rng) for _ in range(5)]
+    stacked = pncounter.PNCounter(
+        pos=np.stack([np.asarray(s.pos) for s in states]),
+        neg=np.stack([np.asarray(s.neg) for s in states]),
+    )
+    by_name = joins.converge("pncounter", stacked)
+    by_spec = joins.converge(spec, stacked)
+    explicit = joins.converge(
+        joins.batched(pncounter.join), stacked, pncounter.zero(8))
+    assert tree_equal(by_name, by_spec)
+    assert tree_equal(by_name, explicit)
+    # the bare-callable convention still requires an explicit neutral
+    with pytest.raises(ValueError):
+        joins.tree_reduce_join(joins.batched(pncounter.join), stacked)
+    with pytest.raises(KeyError):
+        joins.tree_reduce_join("no_such_join", stacked)
 
 
 def test_oplog_join_laws():
