@@ -1,0 +1,249 @@
+"""A/B: singleton writes vs columnar op pages through the ingest front
+door — the write-side dispatch-fusion story, measured.
+
+The single-op arm drives ``ReplicaNode.add_command`` once per write: N
+writes cost N jitted ingest dispatches (N ``merge_dispatches``).  The
+paged arm drives the SAME seeded command stream through a client
+``PageBuilder`` into ``IngestFrontDoor.admit_page``: decode validates the
+page whole, admission drains it as ONE ``add_commands`` call, so N writes
+cost N/page_size dispatches.  Because page ops are transport batches —
+the server re-mints (rid, seq) identity in page order — the two arms must
+land BIT-IDENTICAL node state, version vector, and log planes.
+
+Two phases:
+
+* **parity** — both arms consume the identical stream at a shared size;
+  state/vv/every log plane must be bit-identical and the dispatch counts
+  are pinned (N vs ceil(N/page)), not just reported.
+* **throughput** — each arm at its own steady-state size.  The sizes
+  differ deliberately: one dispatch per op makes the single arm take
+  minutes at paged sizes (and a LARGER log makes each of its dispatches
+  costlier, so the small-stream single number flatters that arm — the
+  reported speedup is a floor, not a cherry-pick).  The paged arm runs
+  at provisioned capacity so steady-state drain cost is measured, not
+  growth recompiles; rep 0 of each arm is an uncounted warm-up that
+  absorbs jit compilation for the shapes in play.
+
+Admission latency is attributed from the front door's own accounting:
+the ``ingest_admit_latency`` histogram (enqueue → drain completion, the
+front-door half) plus the flight recorder's ``op_births`` black-box
+records (the in-node half, joined by wire identity — see
+crdt_tpu.obs.provenance).
+
+Methodology (house rules, benches/bench_baseline.py): medians over reps,
+JSON rows on stdout.
+
+Usage:
+  python benches/bench_ingest.py                   # default shape
+  python benches/bench_ingest.py --tiny            # CI smoke
+  python benches/bench_ingest.py --assert-floor    # fail under 100K w/s
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import statistics
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+
+def _stream(n_ops: int, seed: int):
+    """Seeded command stream: (key, value, ts) triples — the workload
+    generator's shape (single hot-key-set counter deltas) with explicit
+    timestamps so both arms mint identical wire identities."""
+    import random
+
+    rng = random.Random(seed)
+    alphabet = [f"k{i}" for i in range(16)]
+    return [(alphabet[rng.randrange(16)], str(rng.randint(-20, -11)), 100 + i)
+            for i in range(n_ops)]
+
+
+def _fresh_node(capacity: int):
+    from crdt_tpu.api.node import ReplicaNode
+
+    return ReplicaNode(rid=0, capacity=capacity)
+
+
+def _run_single(stream, capacity: int):
+    node = _fresh_node(capacity)
+    t0 = time.perf_counter()
+    for key, value, ts in stream:
+        node.add_command({key: value}, ts=ts)
+    wall = time.perf_counter() - t0
+    return node, wall
+
+
+def _build_pages(stream, page_size: int):
+    """Client-side page assembly, OUTSIDE the timed region: the bench
+    claims writes/s/NODE, and the producer runs on the writer's machine —
+    timing it here would charge the server for client work (and on a
+    single-core host, serialize the two)."""
+    from crdt_tpu.ingest import PageBuilder
+
+    builder = PageBuilder(origin=7, page_size=page_size)
+    pages = []
+    for key, value, ts in stream:
+        raw = builder.add(key, value, ts=ts)
+        if raw is not None:
+            pages.append(raw)
+    raw = builder.flush()
+    if raw is not None:
+        pages.append(raw)
+    return pages
+
+
+def _run_paged(pages, page_size: int, capacity: int):
+    from crdt_tpu.ingest import IngestFrontDoor
+
+    node = _fresh_node(capacity)
+    # max_batch=1: every page drains inline on the submitting thread —
+    # the bench measures drain cost, not deadline waits.  high_water must
+    # clear the page size or every page sheds at the door.
+    front = IngestFrontDoor(node, max_batch=1, flush_deadline_s=0.001,
+                            high_water=max(4096, 2 * page_size))
+    t0 = time.perf_counter()
+    for raw in pages:
+        front.admit_page(raw)
+    wall = time.perf_counter() - t0
+    return node, wall, front
+
+
+def _check_identical(a, b):
+    """Bit-identity between the arms: state, vv, and every log plane."""
+    assert a.get_state() == b.get_state(), "state diverged"
+    assert a.version_vector() == b.version_vector(), "vv diverged"
+    for name in ("ts", "rid", "seq", "key", "val", "payload", "is_num"):
+        pa = np.asarray(getattr(a.log, name))
+        pb = np.asarray(getattr(b.log, name))
+        assert np.array_equal(pa, pb), f"log plane {name!r} diverged"
+
+
+def _dispatches(node) -> int:
+    return int(node.metrics.registry.counter_value("merge_dispatches"))
+
+
+def _admit_latency(node):
+    reg = node.metrics.registry
+    h = reg.histogram("ingest_admit_latency", lane="kv", node="0")
+    if h is None or not h.count:
+        return {}
+    return {"admit_p50_s": round(h.quantile(0.5), 6),
+            "admit_p99_s": round(h.quantile(0.99), 6),
+            "admit_count": h.count}
+
+
+def _pow2_at_least(n: int) -> int:
+    cap = 1024
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--n-ops", type=int, default=32_768,
+                    help="paged-arm throughput stream length")
+    ap.add_argument("--page-size", type=int, default=16_384)
+    ap.add_argument("--n-single", type=int, default=2_048,
+                    help="single-arm throughput stream length")
+    ap.add_argument("--n-parity", type=int, default=4_096,
+                    help="parity-phase stream length (both arms)")
+    ap.add_argument("--parity-page", type=int, default=1_024)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="measured reps per arm (plus one warm-up)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tiny", action="store_true",
+                    help="CI smoke: 2K-op paged arm, 256-op single arm")
+    ap.add_argument("--assert-floor", action="store_true",
+                    help="exit nonzero if paged throughput < 100K writes/s")
+    args = ap.parse_args()
+    if args.tiny:
+        args.n_ops, args.page_size = 2_048, 512
+        args.n_single, args.reps = 256, 1
+        args.n_parity, args.parity_page = 512, 128
+
+    rows = []
+
+    # ---- phase 1: parity (shared stream, bit-identity, pinned counts)
+    parity_stream = _stream(args.n_parity, args.seed)
+    parity_cap = _pow2_at_least(args.n_parity)
+    n_parity_pages = -(-args.n_parity // args.parity_page)
+    node_s, _ = _run_single(parity_stream, parity_cap)
+    node_p, _, _front = _run_paged(
+        _build_pages(parity_stream, args.parity_page), args.parity_page,
+        parity_cap)
+    _check_identical(node_s, node_p)
+    assert _dispatches(node_s) == args.n_parity, "single arm not 1/op"
+    assert _dispatches(node_p) == n_parity_pages, "paged arm not 1/page"
+    rows.append({"phase": "parity", "n_ops": args.n_parity,
+                 "page_size": args.parity_page,
+                 "single_dispatches": args.n_parity,
+                 "paged_dispatches": n_parity_pages,
+                 "bit_identical": True})
+
+    # ---- phase 2: throughput, each arm at its own steady-state size
+    single_stream = _stream(args.n_single, args.seed)
+    single_cap = _pow2_at_least(args.n_single)
+    paged_stream = _stream(args.n_ops, args.seed)
+    paged_cap = _pow2_at_least(args.n_ops)
+    paged_pages = _build_pages(paged_stream, args.page_size)
+    n_pages = len(paged_pages)
+
+    single_walls, paged_walls = [], []
+    last_paged_node = None
+    for rep in range(args.reps + 1):  # rep 0 = uncounted warm-up
+        node_s, wall_s = _run_single(single_stream, single_cap)
+        node_p, wall_p, _front = _run_paged(paged_pages, args.page_size,
+                                            paged_cap)
+        assert _dispatches(node_s) == args.n_single
+        assert _dispatches(node_p) == n_pages
+        if rep == 0:
+            continue
+        single_walls.append(wall_s)
+        paged_walls.append(wall_p)
+        last_paged_node = node_p
+        rows.append({"phase": "throughput", "rep": rep,
+                     "single_s": round(wall_s, 4),
+                     "paged_s": round(wall_p, 4),
+                     "single_dispatches": args.n_single,
+                     "paged_dispatches": n_pages})
+
+    med_s = statistics.median(single_walls)
+    med_p = statistics.median(paged_walls)
+    wps_single = args.n_single / med_s
+    wps_paged = args.n_ops / med_p
+    births = sum(int(r.get("n", 0)) for r in
+                 last_paged_node.events.find(event="op_births"))
+    summary = {
+        "bench": "ingest",
+        "n_ops": args.n_ops, "page_size": args.page_size,
+        "n_single": args.n_single, "reps": args.reps,
+        "single_median_s": round(med_s, 4),
+        "paged_median_s": round(med_p, 4),
+        "single_writes_per_s": round(wps_single),
+        "paged_writes_per_s": round(wps_paged),
+        "speedup": round(wps_paged / wps_single, 2),
+        "dispatch_ratio": round(args.n_ops / n_pages, 1),
+        "bit_identical": True,  # parity phase would have raised
+        "floor_100k_met": wps_paged >= 100_000,
+        "recorded_births": births,
+        **_admit_latency(last_paged_node),
+    }
+    for row in rows:
+        print(json.dumps(row))
+    print(json.dumps(summary))
+    if args.assert_floor and not summary["floor_100k_met"]:
+        print(f"FAIL: paged throughput {wps_paged:.0f} < 100000 writes/s",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,6 +69,20 @@ class LocalCluster:
             MapNode(rid=self.config.rid_base + i, metrics=self.metrics)
             for i in range(self.config.n_replicas)
         ]
+        # per-replica ingest front doors (crdt_tpu.ingest): the HTTP shim
+        # routes every write surface through these admission lanes, so an
+        # HttpCluster-served LocalCluster batches writes exactly like a
+        # NodeHost fleet.  In-process drivers keep calling node
+        # .add_command directly — admission is the FRONT door, not a new
+        # mandatory layer.
+        from crdt_tpu.ingest import front_door_from_config
+
+        self.ingests = [
+            front_door_from_config(self.nodes[i],
+                                   map_node=self.map_nodes[i],
+                                   config=self.config)
+            for i in range(self.config.n_replicas)
+        ]
         self._rng = random.Random(self.config.seed)
         self._ticks = 0
         self._threads: List[threading.Thread] = []

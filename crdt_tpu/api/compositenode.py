@@ -194,6 +194,29 @@ class CompositeNode:
             self.metrics.inc("composite_ops")
             return int(self._pos[kid].sum() - self._neg[kid].sum())
 
+    def upd_many(self, pairs) -> Optional[list]:
+        """Batched update (the ingest admission drain): every
+        (key, delta) applies under ONE lock acquisition, in submission
+        order, with per-op semantics identical to N ``upd`` calls
+        (parity pinned in tests/test_ingest.py).  Returns each key's
+        value after its op; None when down (whole drain 502s)."""
+        with self._lock:
+            if not self.alive:
+                return None
+            out = []
+            for key, delta in pairs:
+                kid = self._kid_locked(str(key))
+                col = self._wcol_locked(self.rid)
+                self._tok[kid, col] = max(self._tok[kid, col], -1) + 1
+                d = int(delta)
+                if d >= 0:
+                    self._pos[kid, col] += d
+                else:
+                    self._neg[kid, col] += -d
+                self.metrics.inc("composite_ops")
+                out.append(int(self._pos[kid].sum() - self._neg[kid].sum()))
+            return out
+
     def rem(self, key: str) -> Optional[bool]:
         """Observed-remove of ``key``: this node's observer row adopts the
         token vector it has seen.  Returns whether a remove was minted

@@ -84,6 +84,7 @@ from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from crdt_tpu.api.cluster import LocalCluster
+from crdt_tpu.ingest import PageFormatError, ShedError
 from crdt_tpu.obs import health
 from crdt_tpu.obs.trace import TRACE_HEADER
 
@@ -141,6 +142,29 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 return getattr(admin, "composite_node", None)
             nodes = getattr(cluster, "composite_nodes", None)
             return nodes[idx] if nodes else None
+
+        @property
+        def ingest(self):
+            """The node's ingest front door (crdt_tpu.ingest), or None —
+            routes fall back to the direct write paths so a bare
+            LocalCluster without front doors keeps serving."""
+            if admin is not None:
+                return getattr(admin, "ingest", None)
+            doors = getattr(cluster, "ingests", None)
+            return doors[idx] if doors else None
+
+        def _send_shed(self, exc: ShedError):
+            """429 Too Many Requests + Retry-After: the loud, explicit
+            face of the shed policy (never a silent drop)."""
+            self._send_bytes(
+                429,
+                json.dumps({
+                    "shed": True, "lane": exc.lane, "n_ops": exc.n_ops,
+                    "retry_after": exc.retry_after_s,
+                }).encode(),
+                "application/json",
+                extra_headers={"Retry-After": f"{exc.retry_after_s:.3f}"},
+            )
 
         def _parse_vv_query(self, url):
             """?vv=<json {rid: seq}> -> dict, None (absent), or the string
@@ -286,6 +310,7 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     seq_node=self.seq_node, map_node=self.map_node,
                     composite_node=self.composite_node,
                     agent=getattr(admin, "agent", None),
+                    ingest=self.ingest,
                 )
                 self._send(200, body, PROM_CTYPE)
             elif url.path == "/ping":
@@ -360,6 +385,29 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
 
         def do_POST(self):
             path = urlparse(self.path).path
+            if path == "/ingest/page":
+                front = self.ingest
+                if front is None:
+                    self._send(404, "no ingest front door on this node")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                if not self.node.alive:
+                    self._send(502, "Unreachable")
+                    return
+                try:
+                    out = front.admit_page(raw)
+                except PageFormatError as e:
+                    # decode-validates-everything: the page is quarantined
+                    # whole (counted + black-boxed inside admit_page); a
+                    # truncated page is ALWAYS "no page", never "some ops"
+                    self._send(400, f"page quarantined: {e}")
+                    return
+                except ShedError as e:
+                    self._send_shed(e)
+                    return
+                self._send(200, json.dumps(out), "application/json")
+                return
             if path.startswith("/admin/") and admin is not None:
                 n = int(self.headers.get("Content-Length", 0))
                 try:
@@ -563,7 +611,19 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     except (TypeError, ValueError):
                         self._send(400, "invalid delta")
                         return
-                    ident = mn.upd(str(body.get("key", "")), delta)
+                    front = self.ingest
+                    if front is not None and front.map is not None:
+                        # singleton writes share the page path's admission
+                        # queue: one drain = one batched mint (parity with
+                        # the direct path pinned in tests/test_ingest.py)
+                        try:
+                            ident = front.admit_map_upd(
+                                str(body.get("key", "")), delta)
+                        except ShedError as e:
+                            self._send_shed(e)
+                            return
+                    else:
+                        ident = mn.upd(str(body.get("key", "")), delta)
                     if ident is None:
                         self._send(502, "Unreachable")
                     else:
@@ -618,7 +678,16 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     except (TypeError, ValueError):
                         self._send(400, "invalid delta")
                         return
-                    value = cn.upd(str(body.get("key", "")), delta)
+                    front = self.ingest
+                    if front is not None and front.composite is not None:
+                        try:
+                            value = front.admit_composite_upd(
+                                str(body.get("key", "")), delta)
+                        except ShedError as e:
+                            self._send_shed(e)
+                            return
+                    else:
+                        value = cn.upd(str(body.get("key", "")), delta)
                     if value is None:
                         self._send(502, "Unreachable")
                     else:
@@ -661,6 +730,21 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 cmd = {str(k): str(v) for k, v in cmd.items()}
             except Exception:
                 self._send(500, "Request body is invalid")  # main.go:179-186
+                return
+            front = self.ingest
+            if front is not None:
+                # the single-op /data route rides the same admission
+                # queue as op pages: concurrent posters fuse into one
+                # jitted ingest dispatch per drain
+                try:
+                    ident = front.admit_kv(cmd)
+                except ShedError as e:
+                    self._send_shed(e)
+                    return
+                if ident is not None:
+                    self._send(200, "Inserted")
+                else:
+                    self._send(502, "Unreachable")
                 return
             if self.node.add_command(cmd):
                 self._send(200, "Inserted")  # main.go:208

@@ -126,6 +126,33 @@ class MapNode:
             })])
             return ident
 
+    def upd_many(
+        self, pairs: List[Tuple[str, int]],
+    ) -> Optional[List[Tuple[int, int]]]:
+        """Batched update mint (the ingest admission drain): every
+        (key, delta) in ``pairs`` lands under ONE lock acquisition and
+        one ``_ingest_locked`` call, in submission order — the same per-
+        op semantics as N ``upd`` calls (parity pinned in
+        tests/test_ingest.py).  Returns the minted idents; None when the
+        node is down (the whole drain 502s, matching the KV lane)."""
+        with self._lock:
+            if not self.alive:
+                return None
+            rows = []
+            idents: List[Tuple[int, int]] = []
+            for key, delta in pairs:
+                kid = self._kid_locked(str(key))
+                seq = self._seq.next()
+                ident = (self.rid, seq)
+                rows.append((ident, {
+                    "upd": str(key), "d": int(delta),
+                    "e": int(self._epoch[kid]),
+                }))
+                idents.append(ident)
+            if rows:
+                self._ingest_locked(rows)
+            return idents
+
     def rem(self, key: str) -> Optional[Tuple[int, int]]:
         """Mint one observed-remove op for ``key``: clears exactly the
         presence tokens this state has seen.  Returns the op identity;

@@ -223,6 +223,43 @@ class RemotePeer:
         """POST /data (main.go:173-215)."""
         return self._post("/data", cmd)
 
+    def post_page(self, raw: bytes) -> Dict[str, Any]:
+        """POST /ingest/page: one packed columnar op page (crdt_tpu
+        .ingest.wire).  Returns the admission verdict:
+
+          {"ok": True, "admitted": n, "dup": bool}  — admitted
+          {"ok": False, "shed": True, "retry_after": s}  — 429'd: back
+              off retry_after seconds and RESEND THE SAME PAGE (the
+              per-origin page_seq watermark makes the retry idempotent)
+          {"ok": False, "quarantined": True}  — 400'd: malformed page
+          {"ok": False}  — transport failure / node down
+        """
+        req = urllib.request.Request(
+            self.url + "/ingest/page", data=raw,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as res:
+                body = res.read()
+        except urllib.error.HTTPError as e:
+            self._note_reachable()  # served an error status: peer is UP
+            if e.code == 429:
+                retry = e.headers.get("Retry-After")
+                return {"ok": False, "shed": True,
+                        "retry_after": float(retry) if retry else 0.05}
+            return {"ok": False, "quarantined": e.code == 400}
+        except (urllib.error.URLError, OSError):
+            self._note_transport_failure()
+            return {"ok": False}
+        self._note_reachable()
+        try:
+            out = json.loads(body)
+        except ValueError:
+            return {"ok": False}
+        return {"ok": True, "admitted": int(out.get("admitted", 0)),
+                "dup": bool(out.get("dup", False))}
+
     def set_alive(self, alive: bool) -> bool:
         """GET /condition/<bool> (main.go:141-152, routing fixed §0.1.7)."""
         return self._get(f"/condition/{str(bool(alive)).lower()}") is not None
@@ -965,6 +1002,16 @@ class NodeHost:
                 seq_node=self.seq_node, map_node=self.map_node,
                 composite_node=self.composite_node,
             )
+        # the ingest front door (crdt_tpu.ingest): every HTTP write —
+        # single-op routes and op pages alike — rides this host's
+        # admission lanes and drains in ONE jitted dispatch per drain
+        from crdt_tpu.ingest import front_door_from_config
+
+        self.ingest = front_door_from_config(
+            self.node, map_node=self.map_node,
+            composite_node=self.composite_node, config=self.config,
+            events=self.node.events,
+        )
         self.nodes = [self.node]  # duck-types as a cluster for the handler
         self.agent = NetworkAgent(
             self.node, peers, self.config, coordinator=coordinator,

@@ -306,6 +306,16 @@ class ReplicaNode:
             self._packer = None
             self._wire = None
         self.log = oplog.empty(capacity)
+        # host-tracked live row count of self.log, or None when unknown
+        # (post-compaction): lets the batched write path skip a jitted
+        # oplog.size dispatch + host sync per drain
+        self._log_rows: Optional[int] = 0
+        # write-behind appends for the native wire cache: the batched
+        # ingest drain queues (ts_abs, rid, seq, kids, vids) rows here and
+        # every _wire reader drains via _flush_wire_locked — the per-op
+        # native calls move off the admission hot path onto the (per-
+        # gossip-round) serving path
+        self._wire_pending: List[Tuple[int, int, int, list, list]] = []
         self.alive = True
         self._seq = SeqGen()
         self._lock = threading.Lock()
@@ -366,6 +376,62 @@ class ReplicaNode:
                 # the op's absolute-ms birth timestamp every observer sees
                 self.recorder.note_birth(seq, ts + self.clock.epoch_ms)
             return True
+
+    def add_commands(
+        self,
+        cmds: List[Dict[str, str]],
+        tss: Optional[List[Optional[int]]] = None,
+    ) -> Optional[List[Tuple[int, int]]]:
+        """Batched write path (the ingest admission drain): mint seqs for
+        every command and land them all in ONE jitted ingest dispatch —
+        the write-side analogue of ``receive_many``.  ``tss[i]`` (None =
+        stamp now) must satisfy the same int32 window as add_command.
+        Returns the minted (rid, seq) idents in submission order, or
+        None when the node is down (every op in the drain 502s whole —
+        same all-or-nothing the single-op route has).
+
+        Unlike add_command, the command dicts are adopted WITHOUT a
+        defensive copy and must not be mutated after the call: op pages
+        deliberately share one dict per distinct (key, value) pair
+        (OpPage.rows), and copying would both defeat that dedup and put
+        an allocation per op back on the hot path."""
+        with self._lock:
+            if not self.alive:
+                return None
+            if not cmds:
+                return []
+            n = len(cmds)
+            if tss is None:
+                now = self.clock.now_ms()
+                tss = [now] * n
+            else:
+                if len(tss) != n:
+                    raise ValueError(
+                        f"{len(tss)} timestamps for {n} commands")
+                if None in tss:
+                    now = self.clock.now_ms()
+                    tss = [now if t is None else t for t in tss]
+            # validate the whole batch BEFORE any bookkeeping mutates
+            # (all-or-nothing, same as the single-op route); min/max scan
+            # the list at C speed — the per-op check only runs to name
+            # the offender once a violation is known to exist
+            if not (0 <= min(tss) and max(tss) < INT32_MAX):
+                i, ts = next((i, t) for i, t in enumerate(tss)
+                             if not (0 <= t < INT32_MAX))
+                raise ValueError(
+                    f"batch op {i}: timestamp {ts} outside the storable "
+                    f"int32 window [0, {INT32_MAX}) (ts == {INT32_MAX} "
+                    "is the SENTINEL padding encoding)"
+                )
+            seq0 = self._seq.reserve(n)
+            with self.metrics.timer("write"):
+                self._ingest_local_batch(cmds, tss, seq0)  # one dispatch
+            if self.recorder.enabled:
+                epoch = self.clock.epoch_ms
+                self.recorder.note_births(
+                    [(seq0 + i, t + epoch) for i, t in enumerate(tss)])
+            rid = self.rid
+            return [(rid, seq0 + i) for i in range(n)]
 
     # ---- read path ----
 
@@ -518,6 +584,7 @@ class ReplicaNode:
                     and not (self.go_compat_gossip and since is None):
                 # (the C++ emitter writes native ts:rid:seq keys; go-compat
                 # full dumps take the Python path)
+                self._flush_wire_locked()
                 return self._wire.payload_json(since)
             payload = self._payload_locked(since)
         return json.dumps(payload).encode()
@@ -699,6 +766,7 @@ class ReplicaNode:
                     self._frontier_array(merged, w),
                 )
                 self.log = folded.tail
+                self._log_rows = None
                 self._frontier = merged
                 self._summary = self._decode_summary(folded.summary)
                 self._summary_cache = (
@@ -756,6 +824,7 @@ class ReplicaNode:
         self.log = oplog.delta_since(
             self.log, self._frontier_array(self._frontier, w)
         )
+        self._log_rows = None
         self._prune_commands_locked()
         self.metrics.inc("frontier_adoptions")
         self.events.emit(
@@ -772,6 +841,7 @@ class ReplicaNode:
             if not (k[1] >= 0 and k[2] <= f.get(k[1], -1))
         }
         if self._wire is not None:
+            self._flush_wire_locked()  # removals must see deferred adds
             epoch = self.clock.epoch_ms
             for k in self._commands.keys() - kept.keys():
                 self._wire.remove(k[0] + epoch, k[1], k[2])
@@ -794,6 +864,9 @@ class ReplicaNode:
         if self._wire is not None:
             from crdt_tpu import native
 
+            # pending rows are already in _commands: the rebuild re-adds
+            # them, so the write-behind queue just resets
+            self._wire_pending.clear()
             self._wire = native.WireStore(self.keys, self.values)
             epoch = self.clock.epoch_ms
             for (ts, rid, seq), cmd in self._commands.items():
@@ -961,7 +1034,122 @@ class ReplicaNode:
                 n: np.asarray(c, bool if n == "is_num" else np.int32)
                 for n, c in cols.items()
             }
-        needed = int(oplog.size(self.log)) + fresh
+        self._merge_batch(ops, fresh)
+        return fresh
+
+    def _ingest_local_batch(
+        self, cmds: List[Dict[str, str]], tss: List[int], seq0: int
+    ) -> int:
+        """The ingest admission drain's hot path (caller holds the lock):
+        append locally-minted rows (cmds[i] at ts tss[i] with seq
+        seq0 + i), already seq-ascending and fresh by construction, so
+        _accept's sort and duplicate/frontier checks are skipped.  Per-op Python cost is trimmed to the bookkeeping gossip
+        needs (command map, writer index, wire cache); everything else is
+        memoized per DISTINCT command dict — op pages share one dict per
+        distinct (key, value) pair (OpPage.rows), so the encode/intern
+        work and the key/val/payload/is_num column values are paid
+        per-table-entry and gathered per-op with one vectorized take.
+        That difference is what puts the paged arm of
+        benches/bench_ingest.py past the single-op arm's throughput."""
+        epoch = self.clock.epoch_ms
+        rid = self.rid
+        by_writer = self._by_writer.setdefault(rid, [])
+        kcache: Dict[str, int] = {}
+        vcache: Dict[str, Tuple[int, int, bool]] = {}
+        # id(cmd) -> (entry idxs, kids, vids); keyed by object identity —
+        # every cmd stays referenced by `cmds` for the whole loop, so ids
+        # are stable.  Callers that pass per-op fresh dicts just miss.
+        icache: Dict[int, Tuple[List[int], List[int], List[int]]] = {}
+        # entry planes: one slot per distinct (key, value) pair
+        e_key: List[int] = []
+        e_val: List[int] = []
+        e_pay: List[int] = []
+        e_num: List[bool] = []
+        # per-op planes
+        c_ts: List[int] = []
+        c_seq: List[int] = []
+        c_eidx: List[int] = []
+        commands = self._commands
+        go_compat = self.go_compat_gossip
+        ts_seen = self._ts_seen
+        pending = self._wire_pending if self._wire is not None else None
+        key_intern = self.keys.intern
+        values = self.values
+        seq = seq0
+        for cmd, ts in zip(cmds, tss):
+            ident = (ts, rid, seq)
+            commands[ident] = cmd
+            if go_compat:
+                ts_seen.add(ts)
+            by_writer.append((ident, cmd))
+            ent = icache.get(id(cmd))
+            if ent is None:
+                eidxs: List[int] = []
+                kids: List[int] = []
+                vids: List[int] = []
+                for k, v in cmd.items():
+                    kid = kcache.get(k)
+                    if kid is None:
+                        kid = kcache[k] = key_intern(k)
+                    enc = vcache.get(v)
+                    if enc is None:
+                        enc = vcache[v] = encode_value(v, values)
+                    eidxs.append(len(e_key))
+                    kids.append(kid)
+                    vids.append(enc[1])  # payload == interned raw-string id
+                    e_key.append(kid)
+                    e_val.append(enc[0])
+                    e_pay.append(enc[1])
+                    e_num.append(enc[2])
+                ent = icache[id(cmd)] = (eidxs, kids, vids)
+            eidxs = ent[0]
+            if len(eidxs) == 1:
+                c_eidx.append(eidxs[0])
+                c_ts.append(ts)
+                c_seq.append(seq)
+            else:  # multi-key command: one log row per pair
+                for e in eidxs:
+                    c_eidx.append(e)
+                    c_ts.append(ts)
+                    c_seq.append(seq)
+            if pending is not None:
+                pending.append((ts + epoch, rid, seq, ent[1], ent[2]))
+            seq += 1
+        self._vv[rid] = max(self._vv.get(rid, -1), seq - 1)
+        fresh = len(c_eidx)
+        if not fresh:  # all-empty commands: bookkeeping only, no dispatch
+            return 0
+        eidx = np.asarray(c_eidx, np.intp)
+        ops = {
+            "ts": np.asarray(c_ts, np.int32),
+            "rid": np.full(fresh, rid, np.int32),
+            "seq": np.asarray(c_seq, np.int32),
+            "key": np.asarray(e_key, np.int32)[eidx],
+            "val": np.asarray(e_val, np.int32)[eidx],
+            "payload": np.asarray(e_pay, np.int32)[eidx],
+            "is_num": np.asarray(e_num, bool)[eidx],
+        }
+        self._merge_batch(ops, fresh)
+        return fresh
+
+    def _flush_wire_locked(self) -> None:
+        """Drain the write-behind wire appends into the native store
+        (caller holds the lock).  The batched ingest drain defers these
+        per-op native calls off the admission hot path; every _wire
+        reader (gossip serve, prune, rebuild) drains first."""
+        if self._wire is not None and self._wire_pending:
+            add_ids = self._wire.add_ids
+            for ts_abs, rid, seq, kids, vids in self._wire_pending:
+                add_ids(ts_abs, rid, seq, kids, vids)
+        self._wire_pending.clear()
+
+    def _merge_batch(self, ops: Dict[str, np.ndarray], fresh: int) -> None:
+        """Land one packed op batch in ONE jitted merge dispatch (shared
+        tail of _ingest and _ingest_local_batch; caller holds the lock)."""
+        size = self._log_rows
+        if size is None:
+            size = int(oplog.size(self.log))
+        needed = size + fresh
         while needed > self.log.capacity:
             self._grow()
         batch_cap = max(fresh, 1)
@@ -987,8 +1175,8 @@ class ReplicaNode:
                 time.perf_counter() - t0,
             )
         self.log = merged
+        self._log_rows = int(n_unique)  # already synced by the assert
         self.metrics.inc("ops_ingested", fresh)
-        return fresh
 
     def _grow(self) -> None:
         # tail-pad capacity doubling (oplog.grow is O(n) and lossless —

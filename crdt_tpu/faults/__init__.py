@@ -21,7 +21,7 @@ from crdt_tpu.faults.schedule import (
     NemesisSchedule,
     SkewEvent,
 )
-from crdt_tpu.faults.transport import FaultyTransport
+from crdt_tpu.faults.transport import FaultyTransport, corrupt_page_bytes
 
 __all__ = [
     "KINDS",
@@ -31,6 +31,7 @@ __all__ = [
     "FaultyTransport",
     "NemesisSchedule",
     "SkewEvent",
+    "corrupt_page_bytes",
     "fsync_stall",
     "plant_corruption",
     "point_latest_at_missing",

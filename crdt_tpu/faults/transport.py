@@ -54,6 +54,22 @@ def _op_of(path: str) -> str:
     return path.split("?", 1)[0].strip("/").replace("/", "_") or "root"
 
 
+def corrupt_page_bytes(raw: bytes, rng) -> bytes:
+    """Wire corruption for a columnar op page (ingest/wire.py): flip one
+    PAYLOAD byte at a seeded offset.  The page crc32 covers everything
+    after the header, so one flipped payload byte always fails decode and
+    the page must be quarantined WHOLE — no op prefix admitted.  The
+    header's identity bytes (origin, page_seq) are deliberately not
+    targeted: they sit outside the checksum, so flipping one forges a
+    DIFFERENT valid page rather than a detectable corruption (an
+    authenticity problem, out of scope for the integrity plane)."""
+    from crdt_tpu.ingest.wire import HEADER_SIZE
+
+    assert len(raw) > HEADER_SIZE, "page has no payload to corrupt"
+    i = rng.randrange(HEADER_SIZE, len(raw))
+    return raw[:i] + bytes([raw[i] ^ 0xFF]) + raw[i + 1:]
+
+
 class FaultyTransport(RemotePeer):
     """RemotePeer that consults a FaultPlane on every request."""
 

@@ -71,6 +71,9 @@ class NemesisReport:
     fault_counts: Dict[str, int] = dataclasses.field(default_factory=dict)
     payload_quarantines: int = 0
     snapshot_quarantines: int = 0
+    sheds: int = 0
+    shed_ops: int = 0
+    page_quarantines: int = 0
     final_keys: int = 0
     composite_ops: int = 0
     final_composite_keys: int = 0
@@ -95,6 +98,11 @@ class NemesisReport:
         if self.composite_ops:
             prop += (f"; composite: {self.composite_ops} ops -> "
                      f"{self.final_composite_keys} keys")
+        if self.sheds:
+            prop += (f"; overload: {self.sheds} sheds "
+                     f"({self.shed_ops} ops turned away), "
+                     f"{self.page_quarantines} corrupt pages quarantined, "
+                     f"provenance 1:1")
         return (
             f"seed {self.seed}: {self.steps} steps x {self.nodes} nodes — "
             f"{self.writes} writes, {self.pulls} pulls ({self.merges} "
@@ -140,6 +148,12 @@ class _Slot:
         from crdt_tpu.utils import checkpoint as ckpt
 
         assert self.host is None
+        if self.soak.overload:
+            # fresh builder per boot: the front door's per-origin page
+            # watermark also resets with the new host, so page_seq 0 is
+            # genuinely new again (origin = slot index, stable)
+            from crdt_tpu.ingest import PageBuilder
+            self.pager = PageBuilder(origin=self.slot, page_size=1 << 20)
         inc = ckpt.bump_incarnation(self.ckpt_dir)
         rid = self.slot + RID_STRIDE * inc
         self.boots += 1
@@ -193,12 +207,22 @@ class NemesisSoak:
                  fault_log: Optional[str] = None,
                  postmortem_dir: Optional[str] = None,
                  assemble_check: bool = False,
-                 composite: bool = False):
+                 composite: bool = False,
+                 overload: bool = False):
         assert nodes >= 2, "nemesis needs a fleet (>= 2 nodes)"
         self.seed = seed
         self.steps = steps
         self.postmortem_dir = postmortem_dir
         self.assemble_check = assemble_check
+        # overload mode: writes also arrive as admission BURSTS through
+        # each host's ingest front door, against a deliberately tiny
+        # high-water mark — sheds must be client-visible (ShedError, the
+        # in-process analogue of HTTP 429), black-boxed, and counted 1:1;
+        # admitted ops still satisfy the prefix oracle after heal
+        self.overload = overload
+        self.sheds_client = 0
+        self.shed_ops_client = 0
+        self.pages_corrupt_client = 0
         # composite mode: the served mapof(pncounter) (api/compositenode)
         # rides every phase — writes mix in composite upd/rem, every edge
         # pull also pulls the composite surface through the SAME faulty
@@ -213,11 +237,20 @@ class NemesisSoak:
         # fleet-shared birth ledger: every slot's flight recorder converts
         # newly-visible seqs to step lags against it (obs/provenance)
         self.ledger = BirthLedger()
+        ingest_kw = {}
+        if overload:
+            # the shed point must be REACHABLE: flush-on-size drains at
+            # ingest_flush_ops, so the high-water mark sits well below it
+            # and a burst piles depth into the shed region before any
+            # size-triggered drain can relieve it
+            ingest_kw = dict(ingest_flush_ops=64, ingest_flush_ms=5.0,
+                             ingest_high_water=24, ingest_retry_after_s=0.01)
         self.config = ClusterConfig(
             n_replicas=nodes, seed=seed,
             gossip_period_ms=600_000,  # external drive only (determinism)
             peer_timeout_s=2.0,
             peer_backoff_base_s=1.0, peer_backoff_cap_s=5.0,
+            **ingest_kw,
         )
         self.rng = random.Random(f"nemesis-soak:{seed}")
         ports = _free_ports(nodes)
@@ -259,6 +292,81 @@ class NemesisSoak:
         if slot.host.node.add_command({f"k{rid}-{seq}": f"v{rid}-{seq}"}):
             self.writes[rid] = seq + 1
             self.report.writes += 1
+
+    def _overload_burst(self) -> None:
+        """Admission burst through a live host's ingest front door, against
+        the overload config's tiny high-water mark.  The driver is
+        single-threaded, so queue depth moves only through these submits
+        and the final explicit flush — every group's outcome is
+        deterministic: it either sheds (client-counted, nothing minted) or
+        admits, and an admitted group's idents must equal the seqs
+        predicted from the write ledger, because drains preserve
+        submission order and sheds mint nothing."""
+        from crdt_tpu.faults.transport import corrupt_page_bytes
+        from crdt_tpu.ingest import PageFormatError, ShedError
+
+        slot = self.rng.choice(self._alive())
+        fd = slot.host.ingest
+        rid = slot.host.node.rid
+        seq = self.writes.get(rid, 0)
+        if self.rng.random() < 0.25:
+            # the page door rides the same policy: a shed page is lost
+            # whole (this client opts not to retry — its page_seq is
+            # simply skipped, which the watermark tolerates), an admitted
+            # one advances the ledger like any write
+            n = self.rng.randint(4, 12)
+            for i in range(n):
+                slot.pager.add(f"k{rid}-{seq + i}", f"v{rid}-{seq + i}")
+            raw = slot.pager.flush()
+            if self.rng.random() < 0.3:
+                # page-corruption rule: one flipped payload byte must
+                # quarantine the page WHOLE — zero of its ops admitted,
+                # the ledger untouched (these keys are re-minted by later
+                # writes at the same seqs, so a partial admission would
+                # trip the prefix oracle)
+                try:
+                    fd.admit_page(corrupt_page_bytes(raw, self.rng),
+                                  timeout=5.0)
+                except PageFormatError:
+                    self.pages_corrupt_client += 1
+                    return
+                raise AssertionError(
+                    "corrupt op page was admitted instead of quarantined")
+            try:
+                res = fd.admit_page(raw, timeout=5.0)
+            except ShedError:
+                self.sheds_client += 1
+                self.shed_ops_client += n
+                return
+            assert not res["dup"] and res["admitted"] == n, res
+            self.writes[rid] = seq + n
+            self.report.writes += n
+            return
+        admitted = []
+        for _ in range(self.rng.randint(6, 12)):
+            n = self.rng.randint(4, 12)
+            items = [(None, {f"k{rid}-{seq + i}": f"v{rid}-{seq + i}"})
+                     for i in range(n)]
+            try:
+                ticket = fd.kv.submit_many(items)
+            except ShedError:
+                self.sheds_client += 1
+                self.shed_ops_client += n
+                continue
+            admitted.append((ticket, seq, n))
+            seq += n
+        fd.kv.flush()
+        for ticket, first, n in admitted:
+            idents = ticket.wait(5.0)
+            assert idents == [(rid, first + i) for i in range(n)], (
+                f"burst group minted {idents[:3]}..., predicted "
+                f"({rid}, {first})..+{n}: admission order broken"
+            )
+        if admitted:
+            _, first, _ = admitted[0]
+            _, last, last_n = admitted[-1]
+            self.writes[rid] = last + last_n
+            self.report.writes += last + last_n - first
 
     def _pull(self) -> None:
         src = self.rng.choice(self._alive())
@@ -315,10 +423,18 @@ class NemesisSoak:
                 slot.host.node.clock.epoch_ms -= skew.skew_ms
                 self.plane.record("clock_skew", node=skew.node,
                                   skew_ms=skew.skew_ms)
-        action = self.rng.choices(
-            ("write", "pull", "checkpoint", "crash", "reboot", "barrier"),
-            weights=(45, 35, 8, 4, 6, 2),
-        )[0]
+        if self.overload:
+            action = self.rng.choices(
+                ("write", "pull", "checkpoint", "crash", "reboot",
+                 "barrier", "overload_burst"),
+                weights=(27, 33, 8, 4, 6, 2, 20),
+            )[0]
+        else:
+            action = self.rng.choices(
+                ("write", "pull", "checkpoint", "crash", "reboot",
+                 "barrier"),
+                weights=(45, 35, 8, 4, 6, 2),
+            )[0]
         getattr(self, f"_{action}")()
 
     # ---- heal phase: recovery provenance + convergence + oracle ----
@@ -483,6 +599,43 @@ class NemesisSoak:
         self.report.payload_quarantines = payload_q
         self.report.snapshot_quarantines = snap_q
 
+    def _check_shed_provenance(self) -> None:
+        """The never-silent contract, audited 1:1: every ShedError the
+        driver caught must appear as an ``ingest_shed`` record in some
+        node's JSONL black box — same shed count, same total op count.
+        Counted from the event logs, NOT the metrics registries: logs
+        persist across reboots, registries are born empty with each
+        incarnation.  And an overload run that never actually shed
+        tested nothing, so zero sheds is itself a failure."""
+        shed_events = []
+        for s in self.slots:
+            shed_events.extend(
+                e for e in read_jsonl(s.event_log_path)
+                if e.get("event") == "ingest_shed")
+        assert self.sheds_client > 0, (
+            "overload soak never tripped the high-water mark: bursts too "
+            "small or shed policy dead"
+        )
+        assert len(shed_events) == self.sheds_client, (
+            f"client saw {self.sheds_client} sheds but the black boxes "
+            f"recorded {len(shed_events)} ingest_shed events"
+        )
+        ops_logged = sum(int(e.get("n_ops", 0)) for e in shed_events)
+        assert ops_logged == self.shed_ops_client, (
+            f"client had {self.shed_ops_client} ops turned away but the "
+            f"black boxes account for {ops_logged}"
+        )
+        page_q = sum(
+            1 for s in self.slots for e in read_jsonl(s.event_log_path)
+            if e.get("event") == "ingest_page_quarantine")
+        assert page_q == self.pages_corrupt_client, (
+            f"{self.pages_corrupt_client} corrupt pages were sent but "
+            f"{page_q} ingest_page_quarantine events were logged"
+        )
+        self.report.sheds = self.sheds_client
+        self.report.shed_ops = self.shed_ops_client
+        self.report.page_quarantines = page_q
+
     def _check_idempotence(self) -> None:
         """Duplicate + reorder delivery against the CONVERGED fleet: a
         full payload applied twice, then an OLDER delta applied after it,
@@ -526,6 +679,8 @@ class NemesisSoak:
         self._check_prefix_oracle()
         self._check_idempotence()
         self._check_quarantine_provenance()
+        if self.overload:
+            self._check_shed_provenance()
         if self.composite:
             self.report.final_composite_keys = len(
                 self.slots[0].host.composite_node.items())
@@ -604,11 +759,12 @@ def run_soak(seed: int, nodes: int, steps: int,
              fault_log: Optional[str] = None,
              postmortem_dir: Optional[str] = None,
              assemble_check: bool = False,
-             composite: bool = False) -> NemesisReport:
+             composite: bool = False,
+             overload: bool = False) -> NemesisReport:
     return NemesisSoak(seed, nodes=nodes, steps=steps,
                        fault_log=fault_log, postmortem_dir=postmortem_dir,
                        assemble_check=assemble_check,
-                       composite=composite).run()
+                       composite=composite, overload=overload).run()
 
 
 def main(argv=None) -> int:
@@ -634,6 +790,11 @@ def main(argv=None) -> int:
     ap.add_argument("--composite", action="store_true",
                     help="also serve + fault + converge the algebra-"
                          "derived mapof(pncounter) composite node")
+    ap.add_argument("--overload", action="store_true",
+                    help="drive admission bursts against a tiny ingest "
+                         "high-water mark and require every shed to be "
+                         "black-boxed 1:1 (client 429s == ingest_shed "
+                         "events, down to the op totals)")
     args = ap.parse_args(argv)
     for k in range(args.seeds):
         seed = args.seed_base + k
@@ -644,10 +805,12 @@ def main(argv=None) -> int:
                 rep = run_soak(seed, args.nodes, args.steps, fault_log=log_a,
                                postmortem_dir=args.postmortem_dir,
                                assemble_check=args.assemble_check,
-                               composite=args.composite)
+                               composite=args.composite,
+                               overload=args.overload)
                 run_soak(seed, args.nodes, args.steps, fault_log=log_b,
                          postmortem_dir=args.postmortem_dir,
-                         composite=args.composite)
+                         composite=args.composite,
+                         overload=args.overload)
                 a = pathlib.Path(log_a).read_bytes()
                 b = pathlib.Path(log_b).read_bytes()
                 assert a == b, (
@@ -660,7 +823,8 @@ def main(argv=None) -> int:
                            fault_log=args.fault_log,
                            postmortem_dir=args.postmortem_dir,
                            assemble_check=args.assemble_check,
-                           composite=args.composite)
+                           composite=args.composite,
+                           overload=args.overload)
             print(f"[nemesis] {rep.summary()}")
     return 0
 

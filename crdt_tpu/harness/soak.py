@@ -48,6 +48,7 @@ class SoakReport:
     barriers_skipped: int
     rounds_to_converge: int
     final_state: Dict[str, str]
+    pages_admitted: int = 0
     # end-of-run registry snapshot (counters + latency summaries): machine-
     # readable companion to __str__, carried into the CLI's JSON line
     metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
@@ -62,10 +63,12 @@ class SoakReport:
         )
 
     def __str__(self) -> str:
+        paged = (f", {self.pages_admitted} op pages"
+                 if self.pages_admitted else "")
         return (
             f"soak: {self.steps} steps, {self.writes_accepted}/"
             f"{self.writes_offered} writes accepted "
-            f"({self.writes_rejected_dead} rejected dead), "
+            f"({self.writes_rejected_dead} rejected dead{paged}), "
             f"{self.gossip_rounds} pulls, {self.kills} kills / "
             f"{self.revivals} revivals, {self.barriers} barriers "
             f"(+{self.barriers_skipped} skipped), converged in "
@@ -255,6 +258,7 @@ class NetworkSoakRunner:
         p_compact: float = 0.1,
         n_keys: int = 6,
         config: Optional[ClusterConfig] = None,
+        p_page: float = 0.0,
     ):
         from crdt_tpu.api.net import NodeHost, RemotePeer
 
@@ -272,6 +276,17 @@ class NetworkSoakRunner:
         self.oracles = [OracleReplica(rid=r) for r in range(n)]
         self.p = (p_write, p_gossip, p_kill, p_revive, p_compact)
         self.keys = [f"k{i}" for i in range(n_keys)]
+        # paged writes: this fraction of write actions arrives as a small
+        # columnar op page through the ingest front door instead of a
+        # single-op POST — the soak then exercises BOTH write surfaces
+        # (whose parity tests/test_ingest.py pins) under kill/revive
+        # schedules.  One builder per host == one writer stream.
+        self.p_page = p_page
+        if p_page:
+            from crdt_tpu.ingest import PageBuilder
+
+            self.pagers = [PageBuilder(origin=r, page_size=1 << 20)
+                           for r in range(n)]
         self.report = SoakReport.zero()
         # flight recorder: shared ledger + report-step clock (as in
         # SoakRunner; the hosts are in-process so the ledger reaches all)
@@ -289,7 +304,9 @@ class NetworkSoakRunner:
         p_write, p_gossip, p_kill, p_revive, p_compact = self.p
         x = self.rng.random()
         i = self.rng.randrange(len(self.hosts))
-        if x < p_write:
+        if x < p_write and self.p_page and self.rng.random() < self.p_page:
+            self._page_write(i)
+        elif x < p_write:
             # numeric-only values: each daemon clock has its own epoch, so
             # cross-writer ts ordering in the oracle mirror is not
             # meaningful — sums are order-free, LWW strings would not be
@@ -329,6 +346,32 @@ class NetworkSoakRunner:
         else:
             pass  # idle step
         r.steps += 1
+
+    def _page_write(self, i: int) -> None:
+        """A burst of numeric writes as ONE columnar op page through host
+        i's ingest front door.  All-or-nothing: an admitted page mirrors
+        every op into the oracle with the node's minted identities (read
+        back from the ascending per-writer index, as the single-op path
+        does); a refused page (dead node) mirrors nothing."""
+        r = self.report
+        n = self.rng.randint(2, 6)
+        pager = self.pagers[i]
+        for _ in range(n):
+            pager.add(self.rng.choice(self.keys),
+                      str(self.rng.randint(-20, 20)))
+        raw = pager.flush()
+        r.writes_offered += n
+        res = self.hosts[i].ingest.admit_page(raw)
+        if res["admitted"]:
+            assert res["admitted"] == n, res
+            r.writes_accepted += n
+            r.pages_admitted += 1
+            node = self.hosts[i].node
+            for ident, cmd in node._by_writer[node.rid][-n:]:
+                self.oracles[i].add_command(cmd, ts=ident[0])
+        else:
+            assert not self.hosts[i].node.alive, "alive daemon refused page"
+            r.writes_rejected_dead += n
 
     def heal_and_check(self, max_rounds: int = 200) -> SoakReport:
         r = self.report
@@ -377,6 +420,10 @@ def main(argv=None) -> int:
                          " dispatch; 1 = reference single-peer rounds")
     ap.add_argument("--network", action="store_true",
                     help="run the soak over real sockets (NetworkSoakRunner)")
+    ap.add_argument("--paged", type=float, default=0.0, metavar="P",
+                    help="network mode: route this fraction of write "
+                         "actions as columnar op pages through the ingest "
+                         "front door (0 disables)")
     ap.add_argument("--platform", choices=["cpu", "tpu", "ambient"],
                     default="cpu",
                     help="JAX backend (default cpu: the soak is a host-path "
@@ -387,6 +434,10 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.paged and not args.network:
+        print("note: --paged applies only in --network mode (the in-memory "
+              "cluster nodes have no front doors); ignoring",
+              file=sys.stderr)
     if args.network and args.compact_every:
         print("note: --compact-every is schedule-driven in --network mode "
               "(the agents' timer loops are not running); barriers come "
@@ -397,6 +448,7 @@ def main(argv=None) -> int:
                 n=args.replicas, seed=seed,
                 config=ClusterConfig(delta_gossip=not args.full_gossip,
                                      fuse_pull_k=args.fuse_k),
+                p_page=args.paged,
             )
             report = runner.run(args.steps)
         else:

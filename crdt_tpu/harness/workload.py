@@ -138,6 +138,74 @@ class WorkloadGenerator:
                 pass  # dead replica: skipped (transport failures only)
         return accepted
 
+    # ---- op-page drive (the ingest front door, POST /ingest/page) ----
+
+    def drive_pages_http(self, urls: List[str], n_writes: int,
+                         page_size: int = 256, timeout: float = 5.0,
+                         max_retries: int = 8) -> dict:
+        """Drive the SAME command stream as drive_http, but batched into
+        columnar op pages per target replica (one PageBuilder per node =
+        one writer stream each).  A 429 shed backs off Retry-After and
+        resends the same page — the per-origin page_seq watermark makes
+        the retry idempotent.  Returns accounting the overload soak
+        checks 1:1 against the server's shed counters:
+        {"admitted", "pages", "sheds", "lost"}."""
+        import time as _time
+
+        from crdt_tpu.ingest import PageBuilder
+
+        builders = [PageBuilder(origin=1000 + i, page_size=page_size)
+                    for i in range(len(urls))]
+        out = {"admitted": 0, "pages": 0, "sheds": 0, "lost": 0}
+
+        def post(target: int, raw: bytes) -> None:
+            out["pages"] += 1
+            for _ in range(max_retries):
+                verdict = self._post_page(urls[target], raw, timeout)
+                if verdict.get("shed"):
+                    out["sheds"] += 1
+                    _time.sleep(float(verdict.get("retry_after", 0.05)))
+                    continue
+                if verdict.get("ok"):
+                    out["admitted"] += int(verdict.get("admitted", 0))
+                return
+            out["lost"] += 1  # gave up after max_retries sheds (counted!)
+
+        for _ in range(n_writes):
+            cmd, target = self.next_command()
+            ((key, value),) = cmd.items()
+            raw = builders[target].add(key, value)
+            if raw is not None:
+                post(target, raw)
+        for target, b in enumerate(builders):
+            raw = b.flush()
+            if raw is not None:
+                post(target, raw)
+        return out
+
+    @staticmethod
+    def _post_page(url: str, raw: bytes, timeout: float) -> dict:
+        req = urllib.request.Request(
+            url + "/ingest/page", data=raw,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as res:
+                body = res.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                retry = e.headers.get("Retry-After")
+                return {"shed": True,
+                        "retry_after": float(retry) if retry else 0.05}
+            return {}
+        except (urllib.error.URLError, OSError):
+            return {}  # dead replica: skipped, like main.go:301-304
+        try:
+            return {"ok": True, **json.loads(body)}
+        except ValueError:
+            return {}
+
     # ---- HTTP drive (works against the Go reference too) ----
 
     def drive_http(self, urls: List[str], n_writes: int, timeout: float = 5.0) -> int:

@@ -1,0 +1,30 @@
+"""High-throughput ingest front door.
+
+Three layers between the HTTP surface and the merge runtime:
+
+* :mod:`crdt_tpu.ingest.wire` — the columnar op-page wire format
+  (``POST /ingest/page``) and the client-side :class:`PageBuilder`;
+* :mod:`crdt_tpu.ingest.admission` — bounded micro-batching admission
+  queues that drain every pending write surface in ONE jitted ingest
+  dispatch per drain;
+* :mod:`crdt_tpu.ingest.shed` — deterministic, loudly-accounted
+  backpressure (429 + Retry-After past the high-water mark).
+
+See crdt_tpu/ingest/README.md for the wire layout, the admission state
+machine, and the gauge reference.
+"""
+from crdt_tpu.ingest.admission import (  # noqa: F401
+    AdmissionQueue,
+    IngestFrontDoor,
+    Ticket,
+    front_door_from_config,
+)
+from crdt_tpu.ingest.shed import ShedError, ShedPolicy  # noqa: F401
+from crdt_tpu.ingest.wire import (  # noqa: F401
+    OpPage,
+    PageBuilder,
+    PageFormatError,
+    WIRE_TS_NOW,
+    decode_page,
+    encode_page,
+)

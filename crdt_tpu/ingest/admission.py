@@ -1,0 +1,345 @@
+"""Micro-batching admission queue: the write-side analogue of PR 2's
+fused pull round.
+
+Every write surface (single-op HTTP routes AND decoded op pages) lands
+in a bounded per-lane queue instead of dispatching immediately; the
+queue drains as ONE flush call per drain — for the KV lane that is one
+``ReplicaNode.add_commands`` and therefore exactly one jitted ingest
+dispatch (one ``merge_dispatches`` increment), however many ops and
+submitters the drain fuses.  Admission ordering stays explicit: drains
+preserve submission order, so each writer stream's ops mint seqs in the
+order they arrived.
+
+Drain triggers (both knobs on ``ClusterConfig``):
+
+* **flush-on-size** — a submission that brings the pending depth to
+  ``max_batch`` drains inline on the submitting thread;
+* **flush-on-deadline** — a waiter whose ticket is still pending after
+  ``flush_deadline_s`` drains the queue itself (cooperative: no
+  background thread is required for liveness, because every HTTP
+  handler waits on its ticket; hosts may still call
+  :meth:`AdmissionQueue.flush_expired` from their loops to bound the
+  latency of fire-and-forget submitters).
+
+Backpressure is delegated to :mod:`crdt_tpu.ingest.shed`: a submission
+that would push depth past the high-water mark raises
+:class:`~crdt_tpu.ingest.shed.ShedError` before enqueueing anything.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from crdt_tpu.ingest import wire
+from crdt_tpu.ingest.shed import ShedError, ShedPolicy
+from crdt_tpu.utils.metrics import Metrics
+
+
+class Ticket:
+    """Hands a submitter the drain result for its ops: ``wait`` blocks
+    until the drain that included them completes (flushing the queue
+    itself once the deadline passes), then returns the per-op results."""
+
+    __slots__ = ("_queue", "_event", "_result", "_error")
+
+    def __init__(self, queue: "AdmissionQueue"):
+        self._queue = queue
+        self._event = threading.Event()
+        self._result: Optional[List[Any]] = None
+        self._error: Optional[BaseException] = None
+
+    def _resolve(self, result: Optional[List[Any]],
+                 error: Optional[BaseException]) -> None:
+        self._result = result
+        self._error = error
+        self._event.set()
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> List[Any]:
+        """Block until drained; the cooperative deadline flush keeps a
+        lone submitter from waiting forever on an idle queue."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._event.is_set():
+            if not self._event.wait(self._queue.flush_deadline_s):
+                # deadline passed with no size-triggered drain: drain now
+                self._queue.flush()
+            if deadline is not None and time.monotonic() >= deadline \
+                    and not self._event.is_set():
+                raise TimeoutError("admission ticket timed out")
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+
+class AdmissionQueue:
+    """One bounded micro-batch lane.
+
+    ``flush_fn(items)`` performs the drain: it receives every pending
+    item in submission order and returns one result per item.  The KV
+    lane's flush_fn is the one-dispatch batched write path; the map and
+    composite lanes batch under one lock acquisition (their state is
+    host-resident — no device dispatch to fuse, but the shared queue
+    gives every surface the same backpressure and accounting).
+    """
+
+    def __init__(self, name: str, flush_fn: Callable[[List[Any]], List[Any]],
+                 *, max_batch: int = 64, flush_deadline_s: float = 0.002,
+                 policy: Optional[ShedPolicy] = None,
+                 metrics: Optional[Metrics] = None,
+                 events=None, node: str = "?"):
+        self.name = name
+        self.flush_fn = flush_fn
+        self.max_batch = max(1, int(max_batch))
+        self.flush_deadline_s = max(1e-4, float(flush_deadline_s))
+        self.policy = policy or ShedPolicy()
+        self.metrics = metrics or Metrics()
+        self.events = events
+        self.node = str(node)
+        self._lock = threading.Lock()          # queue state
+        self._drain_lock = threading.Lock()    # serializes flush_fn calls
+        self._pending: List[Tuple[List[Any], Ticket, float]] = []
+        self._depth = 0
+        self._oldest: Optional[float] = None
+
+    # ---- submission side ----
+
+    @property
+    def depth(self) -> int:
+        """Pending (undrained) op count — the ingest_queue_depth gauge."""
+        return self._depth
+
+    def submit_many(self, items: Sequence[Any]) -> Ticket:
+        """Enqueue a group of ops atomically (one page = one group =
+        all-or-nothing vs the shed policy); returns the group's ticket."""
+        items = list(items)
+        if not items:
+            t = Ticket(self)
+            t._resolve([], None)
+            return t
+        now = time.monotonic()
+        with self._lock:
+            if self.policy.would_shed(self._depth, len(items)):
+                raise self.policy.shed(self.name, len(items), self._depth,
+                                       self.metrics, self.events, self.node)
+            ticket = Ticket(self)
+            self._pending.append((items, ticket, now))
+            self._depth += len(items)
+            if self._oldest is None:
+                self._oldest = now
+            drain_now = self._depth >= self.max_batch
+            self.metrics.registry.set_gauge(
+                "ingest_queue_depth", float(self._depth),
+                lane=self.name, node=self.node)
+        if drain_now:
+            self.flush()
+        return ticket
+
+    def submit(self, item: Any) -> Ticket:
+        return self.submit_many([item])
+
+    # ---- drain side ----
+
+    def flush(self) -> int:
+        """Drain everything pending in ONE flush_fn call; returns the op
+        count drained.  Concurrent callers serialize; late arrivals land
+        in the next drain."""
+        with self._drain_lock:
+            with self._lock:
+                batch = self._pending
+                if not batch:
+                    return 0
+                self._pending = []
+                self._depth = 0
+                self._oldest = None
+                self.metrics.registry.set_gauge(
+                    "ingest_queue_depth", 0.0,
+                    lane=self.name, node=self.node)
+            flat: List[Any] = []
+            for items, _, _ in batch:
+                flat.extend(items)
+            reg = self.metrics.registry
+            t0 = time.monotonic()
+            try:
+                results = self.flush_fn(flat)
+            except BaseException as exc:
+                reg.inc("ingest_drain_errors", lane=self.name, node=self.node)
+                if self.events is not None:
+                    self.events.emit("ingest_drain_error", lane=self.name,
+                                     n_ops=len(flat), error=repr(exc))
+                for _, ticket, _ in batch:
+                    ticket._resolve(None, exc)
+                return len(flat)
+            t1 = time.monotonic()
+            if results is None:
+                results = [None] * len(flat)
+            assert len(results) == len(flat), (
+                f"lane {self.name!r} flush_fn returned {len(results)} "
+                f"results for {len(flat)} items")
+            reg.inc("ingest_drains", lane=self.name, node=self.node)
+            reg.inc("ingest_ops_admitted", float(len(flat)),
+                    lane=self.name, node=self.node)
+            reg.observe("ingest_batch_size", float(len(flat)),
+                        lane=self.name, node=self.node)
+            # admit latency = enqueue -> drain completion, per group (the
+            # flight recorder attributes the in-node half; this histogram
+            # is the front-door half the bench reports)
+            for _, _, t_enq in batch:
+                reg.observe("ingest_admit_latency", t1 - t_enq,
+                            lane=self.name, node=self.node)
+            reg.observe("ingest_drain_seconds", t1 - t0,
+                        lane=self.name, node=self.node)
+            off = 0
+            for items, ticket, _ in batch:
+                ticket._resolve(results[off:off + len(items)], None)
+                off += len(items)
+            return len(flat)
+
+    def flush_expired(self, now: Optional[float] = None) -> int:
+        """Drain only if the oldest pending group has been waiting past
+        the flush deadline (host-loop hook; waiters self-flush anyway)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            expired = (self._oldest is not None
+                       and now - self._oldest >= self.flush_deadline_s)
+        return self.flush() if expired else 0
+
+
+class IngestFrontDoor:
+    """Per-node bundle of admission lanes plus the page door.
+
+    One front door serves one node's write surfaces: the KV lane feeds
+    ``ReplicaNode.add_commands`` (one jitted dispatch per drain), the
+    map/composite lanes feed the sibling lattices' batched write paths.
+    Page admission (decode → dedup → KV lane) lives here so the HTTP
+    shim stays a thin router.
+    """
+
+    def __init__(self, node, map_node=None, composite_node=None, *,
+                 max_batch: int = 64, flush_deadline_s: float = 0.002,
+                 high_water: int = 4096, retry_after_s: float = 0.05,
+                 events=None):
+        self.node = node
+        self.map_node = map_node
+        self.composite_node = composite_node
+        self.events = events if events is not None \
+            else getattr(node, "events", None)
+        policy = ShedPolicy(high_water=high_water,
+                            retry_after_s=retry_after_s)
+        label = str(getattr(node, "rid", "?"))
+        common = dict(max_batch=max_batch, flush_deadline_s=flush_deadline_s,
+                      policy=policy, metrics=node.metrics,
+                      events=self.events, node=label)
+        self.kv = AdmissionQueue("kv", self._flush_kv, **common)
+        self.map = AdmissionQueue("map", self._flush_map, **common) \
+            if map_node is not None else None
+        self.composite = AdmissionQueue(
+            "composite", self._flush_composite, **common) \
+            if composite_node is not None else None
+        # per-origin page-seq watermark: retried pages (shed or timed out
+        # client side AFTER admission) are duplicate-dropped, not
+        # double-applied.  Only ADMITTED pages advance it, so a shed page
+        # retries cleanly under the same page_seq.
+        self._page_watermark: Dict[int, int] = {}
+        self._wm_lock = threading.Lock()
+
+    # ---- lane flush functions (one call per drain) ----
+
+    def _flush_kv(self, items: List[Tuple[Optional[int], Dict[str, str]]]):
+        tss = [ts for ts, _ in items]
+        cmds = [cmd for _, cmd in items]
+        idents = self.node.add_commands(cmds, tss)
+        if idents is None:  # node down: every op in the drain 502s
+            return [None] * len(items)
+        return idents
+
+    def _flush_map(self, items: List[Tuple[str, int]]):
+        return self.map_node.upd_many(items)
+
+    def _flush_composite(self, items: List[Tuple[str, int]]):
+        return self.composite_node.upd_many(items)
+
+    # ---- admission surfaces ----
+
+    def admit_kv(self, cmd: Dict[str, str], ts: Optional[int] = None,
+                 timeout: Optional[float] = 30.0):
+        """Single-op /data route: returns the op's (rid, seq) ident, or
+        None when the node is down.  Raises ShedError under overload."""
+        return self.kv.submit((ts, dict(cmd))).wait(timeout)[0]
+
+    def admit_map_upd(self, key: str, delta: int,
+                      timeout: Optional[float] = 30.0):
+        if self.map is None:
+            raise RuntimeError("no map lane on this front door")
+        return self.map.submit((str(key), int(delta))).wait(timeout)[0]
+
+    def admit_composite_upd(self, key: str, delta: int,
+                            timeout: Optional[float] = 30.0):
+        if self.composite is None:
+            raise RuntimeError("no composite lane on this front door")
+        return self.composite.submit((str(key), int(delta))).wait(timeout)[0]
+
+    def admit_page(self, raw: bytes,
+                   timeout: Optional[float] = 30.0) -> Dict[str, Any]:
+        """POST /ingest/page: decode + validate (PageFormatError on ANY
+        defect — the caller 400s and the page is quarantined whole),
+        dedup on (origin, page_seq), then submit every op to the KV lane
+        as one group.  Returns {"admitted", "dup", "page_seq"}."""
+        reg = self.node.metrics.registry
+        label = self.kv.node
+        reg.inc("ingest_pages", node=label)
+        try:
+            page = wire.decode_page(raw)
+        except wire.PageFormatError:
+            reg.inc("ingest_pages_quarantined", node=label)
+            if self.events is not None:
+                self.events.emit("ingest_page_quarantine", n_bytes=len(raw))
+            raise
+        with self._wm_lock:
+            wm = self._page_watermark.get(page.origin)
+            if wm is not None and page.page_seq <= wm:
+                reg.inc("ingest_pages_duplicate", node=label)
+                return {"admitted": 0, "dup": True,
+                        "page_seq": page.page_seq}
+        ticket = self.kv.submit_many(page.rows())  # ShedError propagates
+        with self._wm_lock:
+            prev = self._page_watermark.get(page.origin)
+            if prev is None or page.page_seq > prev:
+                self._page_watermark[page.origin] = page.page_seq
+        idents = ticket.wait(timeout)
+        admitted = sum(1 for i in idents if i is not None)
+        return {"admitted": admitted, "dup": False,
+                "page_seq": page.page_seq}
+
+    # ---- maintenance ----
+
+    @property
+    def lanes(self) -> List[AdmissionQueue]:
+        return [q for q in (self.kv, self.map, self.composite)
+                if q is not None]
+
+    def flush_all(self) -> int:
+        return sum(q.flush() for q in self.lanes)
+
+    def flush_expired(self) -> int:
+        return sum(q.flush_expired() for q in self.lanes)
+
+
+def front_door_from_config(node, map_node=None, composite_node=None,
+                           config=None, events=None) -> IngestFrontDoor:
+    """Build a front door from ClusterConfig's ingest knobs (defaults
+    when config is None or predates them)."""
+    get = (lambda k, d: getattr(config, k, d)) if config is not None \
+        else (lambda k, d: d)
+    return IngestFrontDoor(
+        node, map_node=map_node, composite_node=composite_node,
+        max_batch=get("ingest_flush_ops", 64),
+        flush_deadline_s=get("ingest_flush_ms", 2.0) / 1e3,
+        high_water=get("ingest_high_water", 4096),
+        retry_after_s=get("ingest_retry_after_s", 0.05),
+        events=events,
+    )

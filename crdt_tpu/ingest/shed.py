@@ -1,0 +1,72 @@
+"""Backpressure and load-shedding for the ingest front door.
+
+The admission queue is BOUNDED: when accepting a submission would push
+the pending-op depth past the high-water mark, the submission is shed —
+rejected whole, before any of its ops enter the queue (a half-admitted
+page would break the page's all-or-nothing contract).  Shedding is:
+
+* **explicit** — the HTTP surface turns :class:`ShedError` into
+  ``429 Too Many Requests`` with a ``Retry-After`` header, so a
+  well-behaved client backs off instead of timing out;
+* **deterministic** — pure threshold on queue depth, no coin flips: the
+  same submission against the same queue state always sheds the same
+  way (the nemesis overload soak replays byte-identically);
+* **loud** — every shed increments ``ingest_shed_total`` (per lane) and
+  ``ingest_shed_ops_total``, and lands an ``ingest_shed`` record in the
+  node's JSONL black box.  Nothing is EVER silently dropped: an op
+  either drains to the merge runtime or is visible in the shed
+  accounting, and the overload soak checks that 1:1 against the
+  client-side 429 count.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class ShedError(Exception):
+    """A submission was rejected by backpressure.  Carries the advisory
+    retry delay the HTTP surface serves as Retry-After (seconds)."""
+
+    def __init__(self, lane: str, n_ops: int, depth: int, high_water: int,
+                 retry_after_s: float):
+        self.lane = lane
+        self.n_ops = n_ops
+        self.depth = depth
+        self.high_water = high_water
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"ingest lane {lane!r} over high-water mark: depth {depth} + "
+            f"{n_ops} ops > {high_water}; retry after {retry_after_s}s")
+
+
+@dataclass(frozen=True)
+class ShedPolicy:
+    """Deterministic depth-threshold shed policy.
+
+    ``high_water`` bounds PENDING OPS per lane (not submissions): a
+    100-op page counts 100 toward the mark.  ``retry_after_s`` is the
+    advisory client backoff — one flush-deadline is enough for a drain
+    to clear the queue under normal service, so the default tracks it.
+    """
+    high_water: int = 4096
+    retry_after_s: float = 0.05
+
+    def would_shed(self, depth: int, n_ops: int) -> bool:
+        """True when admitting ``n_ops`` more onto ``depth`` pending ops
+        would exceed the high-water mark.  A single submission larger
+        than the whole mark always sheds (it could never be admitted)."""
+        return depth + n_ops > self.high_water
+
+    def shed(self, lane: str, n_ops: int, depth: int, metrics, events,
+             node: str) -> ShedError:
+        """Account one shed (counters + black box) and build the error.
+        The caller raises it — accounting and control flow stay
+        separable for the drain-side tests."""
+        reg = metrics.registry
+        reg.inc("ingest_shed", lane=lane, node=node)
+        reg.inc("ingest_shed_ops", float(n_ops), lane=lane, node=node)
+        if events is not None:
+            events.emit("ingest_shed", lane=lane, n_ops=int(n_ops),
+                        depth=int(depth), high_water=int(self.high_water))
+        return ShedError(lane, n_ops, depth, self.high_water,
+                         self.retry_after_s)

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from crdt_tpu.models import oplog
+from crdt_tpu.parallel.compat import shard_map
 from crdt_tpu.ops import pallas_union
 from crdt_tpu.utils.constants import SENTINEL
 
@@ -404,7 +405,7 @@ def sharded_converge(
         max_nu = jax.lax.pmax(jnp.maximum(nu_local, nu_global), axis)
         return out.hi, out.lo, out.val, out.pay, max_nu
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(None, axis),) * 4 + (P(axis),),

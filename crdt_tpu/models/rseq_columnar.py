@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from crdt_tpu.models import rseq
+from crdt_tpu.parallel.compat import shard_map
 from crdt_tpu.ops import pallas_union
 from crdt_tpu.utils.constants import SENTINEL, SENTINEL_PY
 
@@ -376,7 +377,7 @@ def sharded_converge(
         max_nu = jax.lax.pmax(jnp.maximum(nu_local, nu_global), axis)
         return out.keys, out.elem, out.removed, max_nu
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(None, None, axis), P(None, axis), P(None, axis),

@@ -62,6 +62,7 @@ import numpy as np
 from flax import struct
 
 from crdt_tpu.models import rseq, rseq_columnar as rc
+from crdt_tpu.parallel.compat import shard_map
 from crdt_tpu.models.oplog_engine import EngineFallback
 from crdt_tpu.ops import pallas_union
 from crdt_tpu.utils.constants import SENTINEL, SENTINEL_PY
@@ -332,7 +333,7 @@ def sharded_gc_converge(
         max_nu = jax.lax.pmax(jnp.maximum(nu_local, nu_global), axis)
         return out.col.keys, out.col.elem, out.col.removed, out.floor, max_nu
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(None, None, axis), P(None, axis), P(None, axis),

@@ -249,6 +249,16 @@ class WireStore:
         )
         return bool(_lib.crdt_wire_add(self._h, ts_abs, rid, seq, n, kids, vids))
 
+    def add_ids(self, ts_abs: int, rid: int, seq: int,
+                kids: "list[int]", vids: "list[int]") -> bool:
+        """``add`` with pre-interned key/value ids — the batched ingest
+        drain interns each distinct string once per drain and skips the
+        per-op re-intern round trips this method's sibling pays."""
+        n = len(kids)
+        ka = (ctypes.c_int32 * n)(*kids)
+        va = (ctypes.c_int32 * n)(*vids)
+        return bool(_lib.crdt_wire_add(self._h, ts_abs, rid, seq, n, ka, va))
+
     def remove(self, ts_abs: int, rid: int, seq: int) -> bool:
         return bool(_lib.crdt_wire_remove(self._h, ts_abs, rid, seq))
 

@@ -135,6 +135,20 @@ def sample_composite_node(registry, cn) -> None:
     registry.set_gauge("composite_writers", len(cn._writers), node=lab)
 
 
+def sample_ingest(registry, front_door) -> None:
+    """Ingest front-door gauges (crdt_tpu.ingest): per-lane pending-op
+    depth plus the high-water mark it sheds against, scrape-fresh.  The
+    shed/admit counters and the batch-size / admit-latency histograms
+    are recorded at drain time by the admission queue itself; this
+    sampler only refreshes the point-in-time queue state."""
+    for lane in front_door.lanes:
+        registry.set_gauge("ingest_queue_depth", float(lane.depth),
+                           lane=lane.name, node=lane.node)
+        registry.set_gauge("ingest_high_water",
+                           float(lane.policy.high_water),
+                           lane=lane.name, node=lane.node)
+
+
 def sample_peer_circuits(registry, node_label: str, peers) -> None:
     """Partition-state gauges from the NetworkAgent's RemotePeer circuit
     breakers: per-peer breaker state (0 closed / 1 half-open / 2 open),
@@ -159,7 +173,8 @@ def sample_peer_circuits(registry, node_label: str, peers) -> None:
 
 
 def sample_all(registry, node, set_node=None, seq_node=None,
-               map_node=None, composite_node=None, agent=None) -> None:
+               map_node=None, composite_node=None, agent=None,
+               ingest=None) -> None:
     sample_kv_node(registry, node)
     if set_node is not None:
         sample_set_node(registry, set_node)
@@ -171,15 +186,17 @@ def sample_all(registry, node, set_node=None, seq_node=None,
         sample_composite_node(registry, composite_node)
     if agent is not None:
         sample_peer_circuits(registry, str(node.rid), agent.peers)
+    if ingest is not None:
+        sample_ingest(registry, ingest)
 
 
 def render_node_metrics(node, set_node=None, seq_node=None,
                         map_node=None, composite_node=None,
-                        agent=None) -> str:
+                        agent=None, ingest=None) -> str:
     """The GET /metrics body: sample health gauges into the node's
     registry, then render the whole registry as Prometheus text."""
     registry = node.metrics.registry
     sample_all(registry, node, set_node=set_node, seq_node=seq_node,
                map_node=map_node, composite_node=composite_node,
-               agent=agent)
+               agent=agent, ingest=ingest)
     return registry.render_prometheus()

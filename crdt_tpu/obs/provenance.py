@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from crdt_tpu.obs.trace import current_trace
 
@@ -128,6 +128,26 @@ class FlightRecorder:
         if self.events is not None:
             self.events.emit("op_birth", origin=self.rid, seq=seq,
                              op_ts_ms=int(op_ts_ms))
+
+    def note_births(self, births: Sequence[Tuple[int, int]]) -> None:
+        """Batched birth stamp for one admission drain: every (seq,
+        op_ts_ms) lands in the shared ledger individually (the in-process
+        soaks join on it, per op), but the black box gets ONE
+        ``op_births`` record covering the drain's contiguous seq range —
+        per-op event emission is exactly the Python-side cost the batched
+        write path exists to amortize (see obs/README.md)."""
+        if not births:
+            return
+        step = self._now_step()
+        if self.ledger is not None and step is not None:
+            for seq, _ts in births:
+                self.ledger.note(self.rid, seq, step)
+        if self.events is not None:
+            self.events.emit(
+                "op_births", origin=self.rid, n=len(births),
+                seq_first=int(births[0][0]), seq_last=int(births[-1][0]),
+                op_ts_ms_first=int(births[0][1]),
+                op_ts_ms_last=int(births[-1][1]))
 
     # ---- merge side ----
 

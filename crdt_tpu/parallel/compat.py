@@ -1,0 +1,87 @@
+"""Version-drift compatibility for the sharded-execution surface.
+
+The repo targets the modern ``jax.shard_map`` API (jax >= 0.5: top-level
+export, ``check_vma=`` kwarg).  Older releases ship the same transform as
+``jax.experimental.shard_map.shard_map`` with ``check_rep=`` instead of
+``check_vma=``, and the oldest have neither.  Every internal call site
+imports ``shard_map`` from here so the drift is absorbed in ONE place
+(the pattern: resolve at import, raise with an actionable hint only when
+the symbol is actually used).
+
+Resolution order:
+  1. ``jax.shard_map``                      (0.5+ public API, used as-is)
+  2. ``jax.experimental.shard_map.shard_map`` (0.4.x, ``check_vma`` kwarg
+     translated to ``check_rep``)
+  3. ``None`` — calling :func:`shard_map` raises ImportError with the
+     version hint instead of an AttributeError deep inside tracing.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+
+__all__ = ["shard_map", "resolve_shard_map", "HAS_SHARD_MAP",
+           "distributed_is_initialized"]
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` across the same version
+    drift: older releases never exported it — there the coordinator
+    client on the private global state is the initialized signal."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    try:
+        from jax._src import distributed as _dist
+    except ImportError:
+        return False
+    return getattr(_dist.global_state, "client", None) is not None
+
+
+def _wrap_experimental(fn: Callable) -> Callable:
+    """Adapt the jax.experimental.shard_map signature to the modern one.
+
+    The only caller-visible drift is the replication-check kwarg rename
+    (``check_vma`` -> ``check_rep``); positional/keyword mesh+specs are
+    identical in both generations.
+    """
+
+    @functools.wraps(fn)
+    def shard_map_compat(f: Callable, *args: Any, **kwargs: Any) -> Callable:
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return fn(f, *args, **kwargs)
+
+    return shard_map_compat
+
+
+def resolve_shard_map() -> Optional[Callable]:
+    """Return the best available shard_map, or None when absent."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    try:
+        from jax.experimental.shard_map import shard_map as exp_shard_map
+    except ImportError:
+        return None
+    return _wrap_experimental(exp_shard_map)
+
+
+_resolved = resolve_shard_map()
+
+HAS_SHARD_MAP: bool = _resolved is not None
+
+
+def _unavailable(*_args: Any, **_kwargs: Any) -> Callable:
+    raise ImportError(
+        "shard_map is unavailable: this jax build exposes neither "
+        "jax.shard_map (>= 0.5) nor jax.experimental.shard_map (0.4.x). "
+        f"Installed jax == {jax.__version__}; upgrade jax to use the "
+        "sharded convergence paths (crdt_tpu.parallel.mesh, "
+        "models.*_columnar sharded_converge)."
+    )
+
+
+shard_map: Callable = _resolved if _resolved is not None else _unavailable

@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from crdt_tpu.ops import joins
+from crdt_tpu.parallel.compat import shard_map
 from crdt_tpu.parallel import swarm as swarm_lib
 
 
@@ -92,7 +93,7 @@ def sharded_converge(
         top = allreduce_join(join_single, top_local, axis, axis_size, neutral)
         return swarm_lib.broadcast_where_alive(state, alive, top)
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(axis), P(axis)),
@@ -120,7 +121,7 @@ def pmax_converge(mesh: Mesh, axis: str = "replica") -> Callable:
 
         return jax.tree.map(leaf, state)
 
-    shmapped = jax.shard_map(
+    shmapped = shard_map(
         local_step, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(axis)
     )
 

@@ -50,3 +50,10 @@ class SeqGen:
         n = self.count
         self.count += 1
         return n
+
+    def reserve(self, n: int) -> int:
+        """Mint ``n`` consecutive seqs in one step (the batched ingest
+        drain); returns the first.  Equivalent to n next() calls."""
+        first = self.count
+        self.count += n
+        return first

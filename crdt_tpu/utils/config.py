@@ -95,6 +95,20 @@ class ClusterConfig:
     # the breaker, failure re-opens it with a fresh jittered window.
     # 1 = trip on the first failure (the pre-breaker skip behavior).
     peer_failure_threshold: int = 1
+    # ---- ingest front door (crdt_tpu.ingest) ----
+    # micro-batch admission: HTTP writes (single-op routes AND decoded
+    # op pages) queue per node and drain as ONE jitted ingest dispatch.
+    # flush-on-size: a drain triggers when this many ops are pending
+    ingest_flush_ops: int = 64
+    # flush-on-deadline: a waiter drains the queue itself after this many
+    # milliseconds even if the size trigger never fires
+    ingest_flush_ms: float = 2.0
+    # backpressure high-water mark (PENDING OPS per lane): a submission
+    # that would exceed it is shed whole — 429 + Retry-After, counted
+    # under ingest_shed_total, logged to the JSONL black box
+    ingest_high_water: int = 4096
+    # advisory Retry-After (seconds) served with a shed
+    ingest_retry_after_s: float = 0.05
 
     def ports(self) -> List[int]:
         return [self.base_port + i for i in range(self.n_replicas)]

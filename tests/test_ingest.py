@@ -1,0 +1,594 @@
+"""Ingest front door tests: the columnar op-page wire format's
+rejection matrix (decode-validates-everything — a malformed page is
+quarantined WHOLE, a truncated page is "no page" never "some ops"), the
+round-trip property, the micro-batch admission queue's
+one-dispatch-per-drain accounting (the write-side analogue of the
+fused-pull pins in tests/test_pipeline.py), the deterministic shed
+policy's loud 429 + black-box provenance, and the singleton-vs-batched
+parity the shared admission path guarantees."""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from crdt_tpu.api.node import ReplicaNode
+from crdt_tpu.ingest import (
+    AdmissionQueue,
+    IngestFrontDoor,
+    PageBuilder,
+    PageFormatError,
+    ShedError,
+    decode_page,
+    encode_page,
+)
+from crdt_tpu.ingest.wire import HEADER_SIZE, MAX_OPS_PER_PAGE, OpPage
+from crdt_tpu.utils.clock import HostClock
+from crdt_tpu.utils.config import ClusterConfig
+
+
+def _page(n=8, origin=3, page_seq=0, seed=0) -> OpPage:
+    rng = random.Random(seed)
+    keys = [f"k{i}" for i in range(max(2, n // 2))]
+    values = [str(rng.randrange(1000)) for _ in range(max(2, n // 2))]
+    return OpPage(
+        origin=origin, page_seq=page_seq,
+        seq=np.arange(n, dtype=np.uint32),
+        wire_ts=np.asarray([100 + i for i in range(n)], np.int32),
+        key_id=np.asarray([rng.randrange(len(keys)) for _ in range(n)],
+                          np.uint32),
+        val_id=np.asarray([rng.randrange(len(values)) for _ in range(n)],
+                          np.uint32),
+        keys=keys, values=values,
+    )
+
+
+# ---- wire format: round trip ----
+
+
+def test_page_round_trip():
+    p = _page(n=16, seed=7)
+    q = decode_page(encode_page(p))
+    assert q.origin == p.origin and q.page_seq == p.page_seq
+    for a, b in ((q.seq, p.seq), (q.wire_ts, p.wire_ts),
+                 (q.key_id, p.key_id), (q.val_id, p.val_id)):
+        assert np.array_equal(a, b)
+    assert q.keys == p.keys and q.values == p.values
+
+
+def test_page_round_trip_property_sweep():
+    """Seeded sweep: every generated page survives encode->decode with
+    identical planes and rows() materializes the right commands."""
+    for seed in range(12):
+        rng = random.Random(seed)
+        n = rng.randrange(1, 40)
+        p = _page(n=n, origin=rng.randrange(100), page_seq=seed, seed=seed)
+        q = decode_page(encode_page(p))
+        rows = q.rows()
+        assert len(rows) == n
+        for i, (ts, cmd) in enumerate(rows):
+            assert ts == int(p.wire_ts[i])
+            assert cmd == {p.keys[int(p.key_id[i])]:
+                           p.values[int(p.val_id[i])]}
+
+
+def test_builder_emits_at_page_size_and_flush():
+    b = PageBuilder(origin=5, page_size=3)
+    assert b.add("a", "1") is None
+    assert b.add("b", "2") is None
+    raw = b.add("c", "3")
+    assert raw is not None
+    page = decode_page(raw)
+    assert page.n_ops == 3 and page.page_seq == 0
+    assert b.flush() is None  # nothing pending
+    b.add("d", "4")
+    tail = b.flush()
+    assert decode_page(tail).page_seq == 1  # page seqs advance per emit
+    # per-origin op seqs keep increasing across pages
+    assert int(decode_page(tail).seq[0]) == 3
+
+
+def test_builder_interns_repeated_keys_once():
+    b = PageBuilder(origin=1, page_size=8)
+    for _ in range(3):
+        b.add("hot", "1")
+    b.add("cold", "2")
+    page = decode_page(b.flush() or b"")
+    assert sorted(page.keys) == ["cold", "hot"]  # interned, not repeated
+
+
+# ---- wire format: rejection matrix ----
+
+
+@pytest.mark.parametrize("mutate,why", [
+    (lambda raw: b"NOTAPAGE" + raw[8:], "bad magic"),
+    (lambda raw: raw[:8] + b"\xff\x00" + raw[10:], "unknown version"),
+    (lambda raw: raw[:10] + b"\x01\x00" + raw[12:], "reserved flags"),
+    (lambda raw: raw[:12] + (-1).to_bytes(4, "little", signed=True)
+        + raw[16:], "negative origin"),
+    (lambda raw: raw[:20] + (0).to_bytes(4, "little") + raw[24:],
+     "zero ops"),
+    (lambda raw: raw[:20] + (MAX_OPS_PER_PAGE + 1).to_bytes(4, "little")
+        + raw[24:], "n_ops over cap"),
+    (lambda raw: raw[:-1], "truncated tail"),
+    (lambda raw: raw + b"\x00", "trailing garbage"),
+    (lambda raw: raw[:HEADER_SIZE - 4] + b"\x00\x00\x00\x00"
+        + raw[HEADER_SIZE:], "crc mismatch"),
+])
+def test_malformed_page_rejected(mutate, why):
+    raw = encode_page(_page())
+    with pytest.raises(PageFormatError):
+        decode_page(mutate(raw))
+
+
+def test_rejects_non_monotone_seq_plane():
+    p = _page(n=4)
+    p.seq = np.asarray([0, 2, 1, 3], np.uint32)
+    with pytest.raises(PageFormatError, match="strictly increasing"):
+        decode_page(encode_page(p))
+
+
+def test_rejects_out_of_window_ts():
+    p = _page(n=2)
+    p.wire_ts = np.asarray([5, -7], np.int32)  # -7 is not WIRE_TS_NOW
+    with pytest.raises(PageFormatError, match="wire-ts"):
+        decode_page(encode_page(p))
+
+
+def test_rejects_out_of_bounds_ids():
+    p = _page(n=2)
+    p.key_id = np.asarray([0, 99], np.uint32)
+    with pytest.raises(PageFormatError, match="key-id"):
+        decode_page(encode_page(p))
+    p = _page(n=2)
+    p.val_id = np.asarray([0, 99], np.uint32)
+    with pytest.raises(PageFormatError, match="value-id"):
+        decode_page(encode_page(p))
+
+
+def test_truncation_sweep_never_partially_decodes():
+    """FaultyTransport's truncation contract, at the page layer: every
+    proper prefix of a valid page is 'no page' — PageFormatError — never
+    a page with fewer ops."""
+    raw = encode_page(_page(n=8, seed=3))
+    for cut in range(len(raw)):
+        with pytest.raises(PageFormatError):
+            decode_page(raw[:cut])
+
+
+def test_corruption_fuzz_never_partially_admits():
+    """Planted single-byte defects (the nemesis corrupt injector's
+    shape): decode either rejects the page whole, or — only when the
+    flip lands outside every validated field AND survives crc32, which
+    a single-byte flip cannot — yields the original op count.  No
+    outcome admits a subset of ops."""
+    raw = encode_page(_page(n=8, seed=11))
+    rng = random.Random(42)
+    for _ in range(200):
+        pos = rng.randrange(len(raw))
+        flip = bytes([raw[pos] ^ (1 << rng.randrange(8))])
+        bad = raw[:pos] + flip + raw[pos + 1:]
+        try:
+            page = decode_page(bad)
+        except PageFormatError:
+            continue
+        assert page.n_ops == 8  # full page or nothing
+
+
+# ---- admission queue: one dispatch per drain ----
+
+
+def test_kv_drain_is_one_dispatch():
+    """The acceptance pin: however many ops and submitters a drain
+    fuses, it costs exactly ONE merge_dispatches increment — the write-
+    side fused_pull_round."""
+    node = ReplicaNode(rid=0)
+    fd = IngestFrontDoor(node, max_batch=10_000, flush_deadline_s=60.0)
+    for i in range(25):
+        fd.kv.submit((100 + i, {f"k{i}": str(i)}))
+    assert node.metrics.registry.counter_value("merge_dispatches") == 0
+    assert fd.kv.flush() == 25
+    assert node.metrics.registry.counter_value("merge_dispatches") == 1
+    assert len(node.get_state()) == 25
+    reg = node.metrics.registry
+    assert reg.counter_value("ingest_drains", lane="kv", node="0") == 1
+    assert reg.counter_value("ingest_ops_admitted", lane="kv",
+                             node="0") == 25
+    h = reg.histogram("ingest_batch_size", lane="kv", node="0")
+    assert h is not None and h.count == 1
+
+
+def test_page_plus_singletons_fuse_into_one_drain():
+    node = ReplicaNode(rid=0)
+    fd = IngestFrontDoor(node, max_batch=10_000, flush_deadline_s=60.0)
+    b = PageBuilder(origin=9, page_size=4)
+    raw = [b.add(f"p{i}", str(i), ts=10 + i) for i in range(4)][-1]
+    page_ticket = threading.Thread(target=fd.admit_page, args=(raw,))
+    page_ticket.start()
+    fd.kv.submit((50, {"solo": "1"}))
+    # drain everything pending — page ops and the singleton — at once
+    while fd.kv.depth < 5:
+        pass  # page thread enqueues asynchronously; tiny spin
+    assert fd.kv.flush() == 5
+    page_ticket.join()
+    assert node.metrics.registry.counter_value("merge_dispatches") == 1
+    assert len(node.get_state()) == 5
+
+
+def test_flush_on_size_triggers_at_max_batch():
+    node = ReplicaNode(rid=0)
+    fd = IngestFrontDoor(node, max_batch=4, flush_deadline_s=60.0)
+    for i in range(3):
+        fd.kv.submit((i, {f"a{i}": "1"}))
+    assert node.metrics.registry.counter_value("merge_dispatches") == 0
+    t = fd.kv.submit((3, {"a3": "1"}))  # 4th op: size trigger drains inline
+    assert t.done
+    assert node.metrics.registry.counter_value("merge_dispatches") == 1
+    assert fd.kv.depth == 0
+
+
+def test_ticket_deadline_flush_unblocks_lone_writer():
+    """Cooperative flush-on-deadline: a single submitter on an idle
+    queue drains the queue itself after flush_deadline_s — no background
+    thread required for liveness."""
+    node = ReplicaNode(rid=0)
+    fd = IngestFrontDoor(node, max_batch=1000, flush_deadline_s=0.005)
+    ident = fd.admit_kv({"x": "9"}, ts=123)
+    assert ident == (0, 0)
+    assert node.get_state() == {"x": "9"}
+
+
+def test_concurrent_submitters_share_drains():
+    """8 threads x 20 ops against a size-triggered queue: every op lands
+    exactly once and the dispatch count is the DRAIN count (<< op
+    count), pinned by the drain counter staying equal."""
+    node = ReplicaNode(rid=0)
+    fd = IngestFrontDoor(node, max_batch=16, flush_deadline_s=0.002)
+
+    def worker(w):
+        for i in range(20):
+            fd.admit_kv({f"w{w}_{i}": "1"}, ts=w * 100 + i)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    fd.flush_all()
+    assert len(node.get_state()) == 160
+    reg = node.metrics.registry
+    dispatches = reg.counter_value("merge_dispatches")
+    drains = reg.counter_value("ingest_drains", lane="kv", node="0")
+    assert dispatches == drains < 160
+
+
+def test_drain_preserves_submission_order():
+    node = ReplicaNode(rid=0)
+    fd = IngestFrontDoor(node, max_batch=1000, flush_deadline_s=60.0)
+    tickets = [fd.kv.submit((i, {"k": str(i)})) for i in range(10)]
+    fd.kv.flush()
+    idents = [t.wait(1.0)[0] for t in tickets]
+    # seqs mint in submission order: admission ordering stays explicit
+    assert [s for _r, s in idents] == list(range(10))
+    assert node.get_state() == {"k": "45"}  # counter: all 10 deltas landed
+
+
+# ---- shed policy ----
+
+
+def test_shed_is_deterministic_loud_and_total():
+    node = ReplicaNode(rid=4)
+    fd = IngestFrontDoor(node, max_batch=1000, flush_deadline_s=60.0,
+                         high_water=10, retry_after_s=0.25)
+    fd.kv.submit_many([(i, {f"k{i}": "1"}) for i in range(10)])
+    with pytest.raises(ShedError) as ei:
+        fd.kv.submit((99, {"over": "1"}))
+    assert ei.value.retry_after_s == 0.25
+    reg = node.metrics.registry
+    assert reg.counter_value("ingest_shed", lane="kv", node="4") == 1
+    assert reg.counter_value("ingest_shed_ops", lane="kv", node="4") == 1
+    # the black box records the shed (never a silent drop)
+    sheds = node.events.find(event="ingest_shed")
+    assert len(sheds) == 1 and sheds[0]["n_ops"] == 1
+    assert sheds[0]["high_water"] == 10
+    # after a drain the same submission admits: pure depth threshold
+    fd.kv.flush()
+    assert fd.kv.submit((99, {"over": "1"})) is not None
+    # conservation: everything submitted is either admitted or shed
+    fd.flush_all()
+    admitted = reg.counter_value("ingest_ops_admitted", lane="kv", node="4")
+    shed_ops = reg.counter_value("ingest_shed_ops", lane="kv", node="4")
+    assert admitted + shed_ops == 12
+
+
+def test_page_shed_is_all_or_nothing_and_retryable():
+    node = ReplicaNode(rid=0)
+    fd = IngestFrontDoor(node, max_batch=1000, flush_deadline_s=0.005,
+                         high_water=6)
+    b = PageBuilder(origin=2, page_size=4)
+    raw = [b.add(f"x{i}", "1") for i in range(4)][-1]
+    fd.kv.submit_many([(i, {f"fill{i}": "1"}) for i in range(4)])
+    with pytest.raises(ShedError):
+        fd.admit_page(raw)  # 4 pending + 4 page ops > 6
+    assert fd.kv.depth == 4  # nothing from the page entered the queue
+    fd.kv.flush()
+    out = fd.admit_page(raw)  # same page retries cleanly after the drain
+    assert out == {"admitted": 4, "dup": False, "page_seq": 0}
+    # and only now does a replay of it dedup
+    assert fd.admit_page(raw)["dup"] is True
+
+
+def test_oversized_page_always_sheds():
+    node = ReplicaNode(rid=0)
+    fd = IngestFrontDoor(node, high_water=8)
+    b = PageBuilder(origin=1, page_size=16)
+    raw = [b.add(f"y{i}", "1") for i in range(16)][-1]
+    with pytest.raises(ShedError):
+        fd.admit_page(raw)
+
+
+# ---- singleton/batched parity (the shared code path) ----
+
+
+def test_add_commands_parity_with_add_command():
+    """One batched mint == N singleton mints: same state, same vv, same
+    log planes — bit-identical, 1 dispatch vs N."""
+    clock = HostClock()
+    batched = ReplicaNode(rid=0, clock=clock)
+    single = ReplicaNode(rid=0, clock=clock)
+    cmds = [{f"k{i}": str(i), "shared": str(i)} for i in range(12)]
+    tss = [50 + i for i in range(12)]
+    idents = batched.add_commands(cmds, tss)
+    for cmd, ts in zip(cmds, tss):
+        single.add_command(cmd, ts=ts)
+    assert idents == [(0, i) for i in range(12)]
+    assert batched.get_state() == single.get_state()
+    assert batched.version_vector() == single.version_vector()
+    for name in ("ts", "rid", "seq", "key", "val", "payload", "is_num"):
+        assert np.array_equal(np.asarray(getattr(batched.log, name)),
+                              np.asarray(getattr(single.log, name)))
+    assert batched.metrics.registry.counter_value("merge_dispatches") == 1
+    assert single.metrics.registry.counter_value("merge_dispatches") == 12
+
+
+def test_map_upd_many_parity():
+    from crdt_tpu.api.mapnode import MapNode
+
+    a, b = MapNode(rid=1), MapNode(rid=1)
+    pairs = [("ka", 5), ("kb", -3), ("ka", 2), ("kc", 7)]
+    idents_a = a.upd_many(pairs)
+    idents_b = [b.upd(k, d) for k, d in pairs]
+    assert idents_a == idents_b
+    assert a.items() == b.items()
+    assert a.gossip_payload() == b.gossip_payload()
+
+
+def test_composite_upd_many_parity():
+    from crdt_tpu.api.compositenode import CompositeNode
+
+    a, b = CompositeNode(rid=1), CompositeNode(rid=1)
+    pairs = [("ka", 5), ("kb", -3), ("ka", 2)]
+    vals_a = a.upd_many(pairs)
+    vals_b = [b.upd(k, d) for k, d in pairs]
+    assert vals_a == vals_b == [5, -3, 7]
+    assert a.items() == b.items()
+
+
+def test_page_path_state_identical_to_single_op_path():
+    """The bench's bit-identity claim, in miniature: the same write
+    stream through op pages and through singleton add_command lands the
+    IDENTICAL node state and version vector."""
+    clock = HostClock()
+    paged = ReplicaNode(rid=0, clock=clock)
+    single = ReplicaNode(rid=0, clock=clock)
+    fd = IngestFrontDoor(paged, max_batch=10_000, flush_deadline_s=0.005)
+    b = PageBuilder(origin=1, page_size=8)
+    writes = [(f"k{i % 5}", str(i), 100 + i) for i in range(24)]
+    for k, v, ts in writes:
+        raw = b.add(k, v, ts=ts)
+        if raw is not None:
+            fd.admit_page(raw)
+    tail = b.flush()
+    if tail is not None:
+        fd.admit_page(tail)
+    for k, v, ts in writes:
+        single.add_command({k: v}, ts=ts)
+    assert paged.get_state() == single.get_state()
+    assert paged.version_vector() == single.version_vector()
+    for name in ("ts", "rid", "seq", "key", "val", "payload", "is_num"):
+        assert np.array_equal(np.asarray(getattr(paged.log, name)),
+                              np.asarray(getattr(single.log, name)))
+    # 24 ops cost 3 page drains, not 24 dispatches
+    assert paged.metrics.registry.counter_value("merge_dispatches") == 3
+
+
+def test_page_path_gossip_payload_identical_to_single_op_path():
+    """The write-behind wire cache must be invisible to gossip readers:
+    after paged writes, the SERVED payload (dict form and, when the
+    native runtime is in, the direct-to-JSON form) matches a singleton
+    twin byte for byte, full dump and delta alike — and a third replica
+    that pulls from the paged node converges to the twin's state."""
+    import json
+
+    clock = HostClock()
+    paged = ReplicaNode(rid=0, clock=clock)
+    single = ReplicaNode(rid=0, clock=clock)
+    fd = IngestFrontDoor(paged, max_batch=10_000, flush_deadline_s=0.005)
+    b = PageBuilder(origin=1, page_size=16)
+    writes = [(f"k{i % 5}", str(i - 7), 100 + i) for i in range(64)]
+    for k, v, ts in writes:
+        raw = b.add(k, v, ts=ts)
+        if raw is not None:
+            fd.admit_page(raw)
+    tail = b.flush()
+    if tail is not None:
+        fd.admit_page(tail)
+    for k, v, ts in writes:
+        single.add_command({k: v}, ts=ts)
+    for since in (None, {}, {0: 30}, {0: 63}, {7: 3}):
+        assert paged.gossip_payload(since) == single.gossip_payload(since)
+    fp = getattr(paged, "gossip_payload_json", None)
+    fs = getattr(single, "gossip_payload_json", None)
+    if fp is not None and fs is not None:  # native runtime present
+        for since in (None, {0: 30}):
+            jp, js = fp(since), fs(since)
+            if isinstance(jp, (str, bytes)):
+                jp, js = json.loads(jp), json.loads(js)
+            assert jp == js
+    receiver = ReplicaNode(rid=9, clock=clock)
+    receiver.receive(paged.gossip_payload(None))
+    assert receiver.get_state() == single.get_state()
+
+
+# ---- queue mechanics ----
+
+
+def test_flush_fn_error_propagates_to_every_ticket():
+    boom = RuntimeError("drain died")
+
+    def bad_flush(items):
+        raise boom
+
+    q = AdmissionQueue("kv", bad_flush, max_batch=100,
+                       flush_deadline_s=60.0)
+    t1 = q.submit("a")
+    t2 = q.submit("b")
+    q.flush()
+    for t in (t1, t2):
+        with pytest.raises(RuntimeError, match="drain died"):
+            t.wait(1.0)
+    assert q.metrics.registry.counter_value(
+        "ingest_drain_errors", lane="kv", node="?") == 1
+    assert q.depth == 0  # the queue survives a failed drain
+
+
+def test_down_node_fails_drain_whole():
+    node = ReplicaNode(rid=0)
+    node.set_alive(False)
+    fd = IngestFrontDoor(node, max_batch=1000, flush_deadline_s=0.005)
+    assert fd.admit_kv({"x": "1"}) is None  # 502 semantics, not a crash
+    node.set_alive(True)
+    assert fd.admit_kv({"x": "1"}) is not None
+
+
+def test_flush_expired_only_past_deadline():
+    node = ReplicaNode(rid=0)
+    fd = IngestFrontDoor(node, max_batch=1000, flush_deadline_s=30.0)
+    fd.kv.submit((1, {"a": "1"}))
+    assert fd.kv.flush_expired() == 0  # young group: not drained
+    assert fd.kv.depth == 1
+    import time as _t
+    assert fd.kv.flush_expired(now=_t.monotonic() + 31.0) == 1
+
+
+# ---- HTTP surface (NodeHost end to end) ----
+
+
+@pytest.fixture
+def served_host():
+    from crdt_tpu.api.net import NodeHost
+
+    cfg = ClusterConfig(ingest_flush_ops=8, ingest_flush_ms=2.0,
+                        ingest_high_water=16)
+    h = NodeHost(rid=0, peers=[], config=cfg)
+    t = threading.Thread(target=h._server.serve_forever, daemon=True)
+    t.start()
+    yield h
+    h._server.shutdown()
+    h._server.server_close()
+
+
+def _post(url, path, body, raw=None):
+    req = urllib.request.Request(
+        url + path,
+        data=raw if raw is not None else json.dumps(body).encode(),
+        method="POST")
+    with urllib.request.urlopen(req, timeout=5.0) as res:
+        return res.status, json.loads(res.read() or b"{}") \
+            if res.headers.get("Content-Type", "").startswith(
+                "application/json") else res.read().decode()
+
+
+def test_http_page_round_trip_and_dup(served_host):
+    from crdt_tpu.api.net import RemotePeer
+
+    p = RemotePeer(served_host.url)
+    b = PageBuilder(origin=7, page_size=4)
+    raw = [b.add(f"h{i}", str(i)) for i in range(4)][-1]
+    assert p.post_page(raw) == {"ok": True, "admitted": 4, "dup": False}
+    assert p.post_page(raw)["dup"] is True
+    assert p.get_state() == {f"h{i}": str(i) for i in range(4)}
+
+
+def test_http_oversized_page_429_with_retry_after(served_host):
+    b = PageBuilder(origin=7, page_size=32)
+    raw = [b.add(f"o{i}", "1") for i in range(32)][-1]  # 32 > high_water 16
+    req = urllib.request.Request(served_host.url + "/ingest/page",
+                                 data=raw, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5.0)
+    assert ei.value.code == 429
+    assert float(ei.value.headers["Retry-After"]) > 0
+    reg = served_host.node.metrics.registry
+    assert reg.counter_value("ingest_shed", lane="kv", node="0") == 1
+    # RemotePeer surfaces the same verdict structurally
+    from crdt_tpu.api.net import RemotePeer
+    out = RemotePeer(served_host.url).post_page(raw)
+    assert out["shed"] is True and out["retry_after"] > 0
+
+
+def test_http_corrupt_page_400_and_quarantine_counter(served_host):
+    b = PageBuilder(origin=7, page_size=2)
+    raw = [b.add(f"c{i}", "1") for i in range(2)][-1]
+    req = urllib.request.Request(served_host.url + "/ingest/page",
+                                 data=raw[: len(raw) // 2], method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5.0)
+    assert ei.value.code == 400
+    reg = served_host.node.metrics.registry
+    assert reg.counter_value("ingest_pages_quarantined", node="0") == 1
+    assert served_host.node.get_state() == {}  # nothing admitted
+    assert len(served_host.node.events.find(
+        event="ingest_page_quarantine")) == 1
+
+
+def test_http_map_and_composite_upd_ride_admission(served_host):
+    code, out = _post(served_host.url, "/map/upd", {"key": "m", "delta": 4})
+    assert code == 200 and out["rid"] == 0
+    code, out = _post(served_host.url, "/composite/upd",
+                      {"key": "c", "delta": 2})
+    assert code == 200 and out["value"] == 2
+    reg = served_host.node.metrics.registry
+    assert reg.counter_value("ingest_drains", lane="map", node="0") == 1
+    assert reg.counter_value("ingest_drains", lane="composite",
+                             node="0") == 1
+
+
+def test_http_metrics_exposes_ingest_series(served_host):
+    from crdt_tpu.api.net import RemotePeer
+
+    p = RemotePeer(served_host.url)
+    assert p.add_command({"x": "1"})
+    body = urllib.request.urlopen(served_host.url + "/metrics",
+                                  timeout=5.0).read().decode()
+    for series in ("crdt_ingest_queue_depth", "crdt_ingest_high_water",
+                   "crdt_ingest_ops_admitted_total",
+                   "crdt_ingest_batch_size", "crdt_ingest_admit_latency"):
+        assert series in body, series
+
+
+def test_http_data_route_shares_admission(served_host):
+    """The singleton /data route rides the kv lane: its op shows up in
+    the admission accounting, not just the page path's."""
+    from crdt_tpu.api.net import RemotePeer
+
+    assert RemotePeer(served_host.url).add_command({"d": "1"})
+    reg = served_host.node.metrics.registry
+    assert reg.counter_value("ingest_ops_admitted", lane="kv",
+                             node="0") == 1
+    assert served_host.node.get_state() == {"d": "1"}

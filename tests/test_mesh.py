@@ -9,6 +9,7 @@ import pytest
 
 from crdt_tpu.models import gcounter, oplog
 from crdt_tpu.parallel import mesh as mesh_lib
+from crdt_tpu.parallel.compat import shard_map
 from crdt_tpu.parallel import swarm
 from tests import helpers
 from tests.helpers import tree_equal
@@ -89,7 +90,7 @@ def test_allreduce_join_both_paths(n_dev):
         return jax.tree.map(lambda l: l[None], out)
 
     got = jax.jit(
-        jax.shard_map(body, mesh=m, in_specs=P("replica"), out_specs=P("replica"))
+        shard_map(body, mesh=m, in_specs=P("replica"), out_specs=P("replica"))
     )(state)
 
     expect = logs[0]
