@@ -36,3 +36,7 @@ def pytest_addoption(parser):
 def pytest_configure(config):
     if os.environ.get("CRDT_LONG"):
         config.option.long = True
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running end-to-end checks (tier-1 runs -m 'not slow')",
+    )

@@ -15,6 +15,13 @@ Three layers, one gate (``python -m crdt_tpu.analysis``):
   call graph seeded at ``threading.Thread`` targets and executor
   submissions.
 
+Above these sits crdtprove (``python -m crdt_tpu.analysis verify``, the
+verify subpackage): exhaustive small-domain lattice-law verification
+with a committed verdict ledger (CRDT301/302 gate), the semantic jaxpr
+hazard pass (CRDT105–107, wired into the jaxpr tier), and the
+witnessed-race detector that upgrades CRDT201 findings to concrete
+vector-clock evidence under the nemesis soak.
+
 Findings carry file:line, severity, and a drift-stable fingerprint; the
 committed suppressions file (analysis/baseline.json) lets the gate start
 green on a 15k-LoC codebase and ratchet from there (baseline module).
@@ -39,7 +46,12 @@ RULES = {
     "CRDT102": "registered join is not aval-closed (out avals != self avals)",
     "CRDT103": "join claimed structurally commutative has asymmetric jaxpr",
     "CRDT104": "composite claims structural commutativity its parts don't all claim",
+    "CRDT105": "float accumulation inside a join (order-dependent merge results)",
+    "CRDT106": "PRNG/iota/nondeterministic-reduction primitive inside a join",
+    "CRDT107": "narrow-int add/mul inside a join (overflow wrap breaks inflationarity)",
     "CRDT201": "shared mutable state written from thread-reachable code without a lock",
+    "CRDT301": "registered join refuted by the crdtprove bit-blaster",
+    "CRDT302": "registered join missing from (or drifted against) the verdict ledger",
 }
 
 SEVERITY = {
@@ -51,7 +63,12 @@ SEVERITY = {
     "CRDT102": SEV_ERROR,
     "CRDT103": SEV_ERROR,
     "CRDT104": SEV_ERROR,
+    "CRDT105": SEV_ERROR,
+    "CRDT106": SEV_ERROR,
+    "CRDT107": SEV_WARN,
     "CRDT201": SEV_WARN,
+    "CRDT301": SEV_ERROR,
+    "CRDT302": SEV_ERROR,
 }
 
 

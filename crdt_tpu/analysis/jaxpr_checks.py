@@ -169,6 +169,13 @@ def check_registered_joins(rel_base: pathlib.Path) -> List[Finding]:
                              f"functions of their operands"),
                 ))
 
+        # CRDT105-107: semantic hazard pass (float accumulation, PRNG /
+        # nondeterministic reduction, narrow-int wrap) — verify.hazards
+        from crdt_tpu.analysis.verify import hazards
+
+        findings.extend(hazards.check_join_hazards(
+            name, spec, closed.jaxpr, relpath, line))
+
         # CRDT102: aval closure — out avals == self-operand avals
         in_avals = _leaf_avals(a)
         out_avals = [(v.aval.shape, str(v.aval.dtype))

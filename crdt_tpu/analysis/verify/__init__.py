@@ -1,0 +1,38 @@
+"""crdtprove: machine-checked lattice-law verification (the third tier).
+
+The analysis stack now has three tiers:
+
+1. **AST lint** (ast_checks / concurrency) — pattern-level hazards.
+2. **Jaxpr checks** (jaxpr_checks + verify.hazards) — every registered
+   join traced abstractly: purity, aval closure, swap symmetry, and the
+   semantic hazard pass (CRDT105–107).
+3. **crdtprove** (this package) — *exhaustive small-domain bit-blasting*:
+   every registered join is lowered onto a tiny reachable state domain
+   (``JoinSpec.small`` seeds, or fixed-seed ``rand`` draws), the domain is
+   closed under the join, and the five lattice laws are checked over the
+   FULL product space (pairs for commutativity, triples for
+   associativity) in one vmapped sweep per law.  Composites recurse
+   through the PR-6 combinators: they are proved over their own domains
+   AND owe combinator obligations (semidirect act laws, lexicographic
+   rank-chain) discharged over the part domains.
+
+Verdicts — ``proved`` / ``refuted`` / ``assumed`` (with reason) — are
+keyed by line-drift-stable jaxpr fingerprints and committed to
+``crdt_tpu/analysis/verdicts.json`` (ledger module).  The CI gate
+(``python -m crdt_tpu.analysis verify --check-ledger``) fails on a
+refuted law, a fingerprint that drifted from the ledger, or a registered
+join with no verdict at all — so a NEW join cannot land unverified.
+
+The package also ships the witnessed-race detector (race module): a
+vector-clock happens-before checker instrumented over the threaded
+runtime, upgrading CRDT201 findings from static heuristic to concrete
+conflicting-access pairs with stacks.
+"""
+from __future__ import annotations
+
+from crdt_tpu.analysis.verify.prove import (  # noqa: F401
+    LAWS,
+    blast_call_count,
+    join_fingerprint,
+    prove_spec,
+)

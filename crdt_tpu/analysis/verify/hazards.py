@@ -1,0 +1,112 @@
+"""Semantic jaxpr hazard pass over registered joins (CRDT105–107).
+
+The jaxpr tier (jaxpr_checks) proves structural facts — purity, aval
+closure, swap symmetry.  This pass reads the SEMANTICS of the traced
+primitives and flags computations that can silently break the lattice
+laws even when every structural check passes:
+
+CRDT105 float accumulation (error)
+    Floating-point add / sub / mul / div / reduce_sum / cumsum /
+    dot_general inside a join.  Float arithmetic is not associative
+    (rounding depends on evaluation order), so a join built on it cannot
+    satisfy the associativity law bitwise — merges become
+    schedule-dependent, which is exactly the non-convergence CRDTs
+    exist to rule out.  Every shipped lattice is int/bool; a float
+    plane needs an order-independent encoding (e.g. fixed-point int)
+    before it can claim join semantics.
+
+CRDT106 nondeterminism (error)
+    PRNG primitives (threefry / rng_bit_generator / random_*) make the
+    join a function of hidden state, not of its operands; scatter-add
+    on floats applies updates in an unspecified order (non-associative
+    accumulation again); and ``iota`` inside a join *claiming*
+    ``structurally_commutative`` is an index-dependent value source
+    that swap canonicalization can mask.
+
+CRDT107 narrow-int wrap (warn)
+    add / mul on int8/int16 operands: two mid-range values overflow and
+    wrap, which breaks inflationarity (a ∨ b jumps BELOW a).  Warn, not
+    error: saturating encodings are legitimate, but they must cap, not
+    wrap — the bit-blaster's inflationarity law is the ground truth.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from crdt_tpu.analysis import Finding
+
+#: accumulation primitives that are order-sensitive on floats
+_FLOAT_ACC_PRIMS = {"add", "sub", "mul", "div", "reduce_sum", "cumsum",
+                    "dot_general"}
+
+#: primitive-name substrings that mark hidden-state randomness
+_PRNG_MARKERS = ("threefry", "rng_bit_generator", "random_gamma",
+                 "random_bits", "random_seed", "random_split",
+                 "random_fold_in", "random_wrap")
+
+#: dtypes whose add/mul wrap within plausible lattice value ranges
+_NARROW_INTS = {"int8", "int16", "uint8", "uint16"}
+
+
+def _out_dtype(eqn) -> str:
+    aval = getattr(eqn.outvars[0], "aval", None)
+    return str(getattr(aval, "dtype", ""))
+
+
+def _is_float(dtype: str) -> bool:
+    return dtype.startswith("float") or dtype.startswith("bfloat")
+
+
+def check_join_hazards(name: str, spec, jaxpr, relpath: str,
+                       line: int) -> List[Finding]:
+    """Hazard findings for one traced join (called from the
+    jaxpr_checks loop so run_all / the baseline gate cover them)."""
+    from crdt_tpu.analysis.jaxpr_checks import _iter_eqns
+
+    findings: List[Finding] = []
+    seen = set()  # (rule, tag): one finding per hazard kind per join
+
+    def emit(rule: str, tag: str, message: str) -> None:
+        if (rule, tag) in seen:
+            return
+        seen.add((rule, tag))
+        findings.append(Finding(
+            rule=rule, path=relpath, line=line, scope=name,
+            detail=f"{name}|{tag}", message=message))
+
+    for eqn in _iter_eqns(jaxpr):
+        prim = eqn.primitive.name
+        dtype = _out_dtype(eqn)
+
+        if prim in _FLOAT_ACC_PRIMS and _is_float(dtype):
+            emit("CRDT105", f"{prim}:{dtype}",
+                 f"join '{name}' accumulates in floating point "
+                 f"('{prim}' on {dtype}): float arithmetic is not "
+                 f"associative, so merge results depend on gossip "
+                 f"order — use an order-independent encoding "
+                 f"(fixed-point int) or drop the join claim")
+
+        if any(m in prim for m in _PRNG_MARKERS):
+            emit("CRDT106", prim,
+                 f"join '{name}' traces PRNG primitive '{prim}': the "
+                 f"merge is a function of hidden randomness, not of "
+                 f"its operands — replicas cannot converge")
+        if prim == "scatter-add" and _is_float(dtype):
+            emit("CRDT106", f"{prim}:{dtype}",
+                 f"join '{name}' float scatter-add: colliding updates "
+                 f"apply in unspecified order (non-associative float "
+                 f"accumulation)")
+        if prim == "iota" and spec.structurally_commutative:
+            emit("CRDT106", "iota",
+                 f"join '{name}' claims structural commutativity but "
+                 f"traces 'iota': index-generated values are operand-"
+                 f"order artifacts the swap canonicalization can mask "
+                 f"— drop the claim or derive indices from operands")
+
+        if prim in ("add", "mul") and dtype in _NARROW_INTS:
+            emit("CRDT107", f"{prim}:{dtype}",
+                 f"join '{name}' does '{prim}' on {dtype}: narrow-int "
+                 f"overflow wraps (a ∨ b can land BELOW a, breaking "
+                 f"inflationarity) — saturate explicitly or widen "
+                 f"before accumulating")
+    return findings

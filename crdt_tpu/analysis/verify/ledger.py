@@ -1,0 +1,165 @@
+"""The committed verdict ledger (crdt_tpu/analysis/verdicts.json).
+
+One entry per registered join, keyed by name, carrying the join's jaxpr
+fingerprint and its law verdict:
+
+* ``proved``  — every lattice law (and every combinator obligation)
+  holds exhaustively over a join-closed small domain, and — for
+  composites — every part is itself ``proved``;
+* ``refuted`` — some law or obligation has a concrete counterexample
+  (recorded in the entry);
+* ``assumed`` — laws hold on the checked subspace but something keeps
+  the verdict short of proved (unclosed domain, a part that is only
+  assumed, no domain metadata); the ``reason`` field says exactly what.
+
+The fingerprint (verify.prove.join_fingerprint) is the cache key: a
+ledger recompute SKIPS bit-blasting for any join whose fingerprint is
+unchanged (pinned by tests/test_verify.py via the blast call counter),
+and the CI gate (``--check-ledger``) is fingerprint-only — it traces
+every registered join (cheap) and fails when
+
+* a registered join has no ledger entry (new join landed unverified),
+* an entry's fingerprint differs from the live join (body drifted —
+  rerun ``verify --write-ledger``), or
+* any entry is ``refuted``.
+
+Ledger entries for joins that are no longer registered are reported as
+stale but do not fail the gate (deleting a model shouldn't need a
+ledger edit in the same commit to stay green).
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_LEDGER = (pathlib.Path(__file__).resolve().parent.parent
+                  / "verdicts.json")
+
+LEDGER_VERSION = 1
+
+
+def load(path: Optional[pathlib.Path] = None) -> Optional[dict]:
+    p = pathlib.Path(path) if path else DEFAULT_LEDGER
+    if not p.exists():
+        return None
+    return json.loads(p.read_text())
+
+
+def save(ledger: dict, path: Optional[pathlib.Path] = None) -> None:
+    p = pathlib.Path(path) if path else DEFAULT_LEDGER
+    p.write_text(json.dumps(ledger, indent=1, sort_keys=True) + "\n")
+
+
+def _downgrade_composites(entries: Dict[str, dict]) -> None:
+    """A composite is only ``proved`` when every part is.  Runs to a
+    fixpoint so composites-of-composites propagate."""
+    changed = True
+    while changed:
+        changed = False
+        for name, entry in entries.items():
+            if entry["verdict"] != "proved" or not entry.get("parts"):
+                continue
+            weak = [p for p in entry["parts"]
+                    if entries.get(p, {}).get("verdict") != "proved"]
+            if weak:
+                entry["verdict"] = "assumed"
+                entry["reason"] = (
+                    "own laws and obligations proved, but part(s) "
+                    + ", ".join(repr(p) for p in weak)
+                    + " are not themselves proved")
+                changed = True
+
+
+def compute(cached: Optional[dict] = None, cap: Optional[int] = None,
+            registry=None) -> Tuple[dict, List[str]]:
+    """Build a fresh ledger over every registered join.
+
+    ``cached`` (a previously computed/loaded ledger) short-circuits
+    bit-blasting for joins whose fingerprint is unchanged.  Returns
+    (ledger, names actually recomputed).
+    """
+    from crdt_tpu.analysis.verify import prove
+    from crdt_tpu.analysis.verify.domains import DEFAULT_CAP
+
+    if registry is None:
+        from crdt_tpu.ops.joins import registered_joins
+
+        registry = registered_joins()
+    cap = cap or DEFAULT_CAP
+    old = (cached or {}).get("joins", {})
+    entries: Dict[str, dict] = {}
+    recomputed: List[str] = []
+    for name, spec in sorted(registry.items()):
+        fp = prove.join_fingerprint(spec)
+        prior = old.get(name)
+        if prior is not None and prior.get("fingerprint") == fp:
+            entries[name] = dict(prior)
+            continue
+        entry = prove.prove_spec(spec, registry, cap=cap)
+        entry["fingerprint"] = fp
+        entry["parts"] = list(spec.parts)
+        entry["combinator"] = spec.combinator
+        entries[name] = entry
+        recomputed.append(name)
+    _downgrade_composites(entries)
+    return {"version": LEDGER_VERSION, "cap": cap, "joins": entries}, recomputed
+
+
+def check(ledger: Optional[dict] = None,
+          path: Optional[pathlib.Path] = None,
+          registry=None) -> Tuple[List[str], List[str]]:
+    """Fingerprint-only gate: (problems, stale).  Empty problems ⇔ every
+    registered join has a matching non-refuted ledger entry."""
+    from crdt_tpu.analysis.verify import prove
+
+    if ledger is None:
+        ledger = load(path)
+    if registry is None:
+        from crdt_tpu.ops.joins import registered_joins
+
+        registry = registered_joins()
+    problems: List[str] = []
+    if ledger is None:
+        return ([f"no verdict ledger at {path or DEFAULT_LEDGER}; run "
+                 f"`python -m crdt_tpu.analysis verify --write-ledger`"], [])
+    entries = ledger.get("joins", {})
+    for name, spec in sorted(registry.items()):
+        entry = entries.get(name)
+        if entry is None:
+            problems.append(
+                f"join '{name}' is registered but has no ledger verdict — "
+                f"run `verify --write-ledger`")
+            continue
+        fp = prove.join_fingerprint(spec)
+        if entry.get("fingerprint") != fp:
+            problems.append(
+                f"join '{name}' drifted: ledger fingerprint "
+                f"{entry.get('fingerprint')} != live {fp} — rerun "
+                f"`verify --write-ledger` to re-prove it")
+        if entry.get("verdict") == "refuted":
+            bad = (entry.get("refuted_laws", [])
+                   + entry.get("refuted_obligations", []))
+            problems.append(
+                f"join '{name}' is REFUTED ({', '.join(bad) or 'law'}) — "
+                f"see its counterexample in the ledger")
+    stale = sorted(set(entries) - set(registry))
+    return problems, stale
+
+
+def annotate_registry(path: Optional[pathlib.Path] = None) -> None:
+    """Push ledger verdicts into the live registry's ``verified`` field:
+    True iff the entry is ``proved`` AND its fingerprint still matches
+    the live join (a drifted join is not verified, whatever the ledger
+    says)."""
+    from crdt_tpu.analysis.verify import prove
+    from crdt_tpu.ops.joins import mark_verified, registered_joins
+
+    ledger = load(path)
+    entries = (ledger or {}).get("joins", {})
+    for name, spec in registered_joins().items():
+        entry = entries.get(name)
+        ok = (entry is not None
+              and entry.get("verdict") == "proved"
+              and entry.get("fingerprint") == prove.join_fingerprint(spec))
+        mark_verified(name, ok)

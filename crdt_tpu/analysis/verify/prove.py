@@ -1,0 +1,443 @@
+"""Exhaustive lattice-law checking over small domains (the bit-blaster).
+
+For one :class:`~crdt_tpu.ops.joins.JoinSpec` the prover builds a small
+reachable domain (domains module), stacks it, and checks the five
+lattice laws over the FULL product space in vmapped sweeps:
+
+=================  ==========================================  =========
+law                equation checked                            space
+=================  ==========================================  =========
+commutative        join(a, b) == join(b, a)                    n² pairs
+associative        join(join(a,b), c) == join(a, join(b,c))    n³ triples
+idempotent         join(a, a) == a                             n states
+neutral            join(a, z) == a == join(z, a)               n states
+inflationary       join(a, join(a,b)) == join(a,b) (a ≤ a∨b    n² pairs
+                   in the join-characterized order, both
+                   operands)
+=================  ==========================================  =========
+
+Equality is bitwise per pytree leaf (every shipped lattice is int/bool;
+a float lattice that needs tolerance is exactly the hazard CRDT105
+exists to flag).  The first violating row is decoded back into concrete
+operand states and reported as the law's counterexample.
+
+Combinator obligations (composites): a composite's own laws are checked
+over its own domain like any join, and additionally
+
+* ``semidirect(a, act, b)`` — the three act laws (identity,
+  composition over join-generated frame chains, join-homomorphism) are
+  checked exhaustively over the part domains;
+* ``lexicographic(a, b, rank)`` — the rank-chain obligation: ``rank``
+  must be injective over the a-domain (equal rank ⇒ identical state),
+  or a-dominance is not a total order and the composite's laws only
+  held because the domain missed a tie.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Optional
+
+import numpy as np
+
+from crdt_tpu.analysis.verify import domains as dom_mod
+from crdt_tpu.analysis.verify.domains import (
+    DEFAULT_CAP,
+    Domain,
+    build_domain,
+    stack,
+)
+
+LAWS = ("commutative", "associative", "idempotent", "neutral",
+        "inflationary")
+
+#: triple-sweep chunk: bounds peak memory on the big-leaf lattices
+#: (compactlog rows × 46k triples would otherwise buffer ~100s of MB)
+_CHUNK = 8192
+
+#: how many times prove_spec actually blasted (cache-invalidation tests
+#: pin ledger recomputes against this)
+_BLAST_CALLS = 0
+
+
+def blast_call_count() -> int:
+    return _BLAST_CALLS
+
+
+def join_fingerprint(spec) -> str:
+    """Line-drift-stable identity of a join's traced body: sha1 over the
+    alpha-renamed, commutativity-canonicalized jaxpr plus the operand
+    avals.  Changes iff the join's computation (or its registered state
+    layout) changes — the ledger's cache key."""
+    import jax
+
+    from crdt_tpu.analysis.jaxpr_checks import _canonical_lines, _leaf_avals
+
+    a, b = spec.example()
+    closed = jax.make_jaxpr(spec.join)(a, b)
+    payload = ("\n".join(_canonical_lines(closed.jaxpr))
+               + repr(_leaf_avals(a)) + repr(_leaf_avals(b)))
+    return hashlib.sha1(payload.encode()).hexdigest()[:16]
+
+
+def summarize_state(state, max_elems: int = 24) -> Dict[str, str]:
+    """Compact leaf-wise repr of one state for counterexample reports."""
+    import jax
+
+    out: Dict[str, str] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        arr = np.asarray(leaf)
+        vals = arr.ravel()[:max_elems].tolist()
+        text = f"{arr.dtype}{list(arr.shape)}:{vals}"
+        if arr.size > max_elems:
+            text += "..."
+        out[jax.tree_util.keystr(path) or "."] = text
+    return out
+
+
+def _rows_equal(x, y, rows: int) -> np.ndarray:
+    """Bitwise per-row equality of two stacked pytrees."""
+    import jax
+
+    eq = np.ones(rows, bool)
+    for lx, ly in zip(jax.tree.leaves(x), jax.tree.leaves(y)):
+        ax = np.asarray(lx).reshape(rows, -1)
+        ay = np.asarray(ly).reshape(rows, -1)
+        eq &= (ax == ay).all(axis=1)
+    return eq
+
+
+def _first_bad(eq: np.ndarray) -> Optional[int]:
+    bad = np.flatnonzero(~eq)
+    return int(bad[0]) if bad.size else None
+
+
+def _gather(tree, idx):
+    import jax
+
+    return jax.tree.map(lambda x: x[idx], tree)
+
+
+def _chunked(vfn, rows: int, *operands):
+    """Apply a vmapped fn over stacked operands in bounded chunks (peak
+    memory stays ~_CHUNK rows regardless of the sweep size)."""
+    import jax
+    import jax.numpy as jnp
+
+    if rows <= _CHUNK:
+        return vfn(*operands)
+    outs = []
+    for lo in range(0, rows, _CHUNK):
+        sel = np.arange(lo, min(lo + _CHUNK, rows))
+        outs.append(vfn(*(_gather(op, sel) for op in operands)))
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs), *outs)
+
+
+def _law(holds: bool, space: int, counterexample=None) -> dict:
+    entry = {"holds": bool(holds), "space": int(space)}
+    if counterexample is not None:
+        entry["counterexample"] = counterexample
+    return entry
+
+
+def _pair_ce(dom: Domain, ii, jj, r: int, lhs, rhs) -> dict:
+    return {
+        "a": summarize_state(dom.states[int(ii[r])]),
+        "b": summarize_state(dom.states[int(jj[r])]),
+        "lhs": summarize_state(_gather(lhs, r)),
+        "rhs": summarize_state(_gather(rhs, r)),
+    }
+
+
+def check_laws(spec, dom: Domain) -> Dict[str, dict]:
+    """The five-law sweep over a prebuilt domain.  Returns per-law
+    {holds, space, counterexample?}."""
+    import jax
+
+    n = len(dom.states)
+    S = stack(dom.states)
+    vjoin = jax.jit(jax.vmap(spec.join))
+    laws: Dict[str, dict] = {}
+
+    ii, jj = (m.ravel() for m in np.meshgrid(
+        np.arange(n), np.arange(n), indexing="ij"))
+    A, B = _gather(S, ii), _gather(S, jj)
+    jab = vjoin(A, B)
+
+    # commutative: join(a,b) == join(b,a)
+    jba = vjoin(B, A)
+    eq = _rows_equal(jab, jba, n * n)
+    r = _first_bad(eq)
+    laws["commutative"] = _law(
+        r is None, n * n,
+        None if r is None else _pair_ce(dom, ii, jj, r, jab, jba))
+
+    # idempotent: join(a,a) == a
+    jaa = vjoin(S, S)
+    eq = _rows_equal(jaa, S, n)
+    r = _first_bad(eq)
+    laws["idempotent"] = _law(
+        r is None, n,
+        None if r is None else {
+            "a": summarize_state(dom.states[r]),
+            "lhs": summarize_state(_gather(jaa, r)),
+            "rhs": summarize_state(dom.states[r]),
+        })
+
+    # neutral: join(a,z) == a == join(z,a)
+    if spec.neutral is None:
+        laws["neutral"] = _law(True, 0)
+        laws["neutral"]["skipped"] = "no neutral registered"
+    else:
+        Z = stack([spec.neutral()] * n)
+        az = vjoin(S, Z)
+        za = vjoin(Z, S)
+        eq = _rows_equal(az, S, n) & _rows_equal(za, S, n)
+        r = _first_bad(eq)
+        laws["neutral"] = _law(
+            r is None, n,
+            None if r is None else {
+                "a": summarize_state(dom.states[r]),
+                "lhs": summarize_state(_gather(az, r)),
+                "rhs": summarize_state(dom.states[r]),
+            })
+
+    # associative: join(join(a,b),c) == join(a,join(b,c)) over triples,
+    # reusing jab for both association orders.  Chunked with per-chunk
+    # gathers so peak memory stays ~_CHUNK rows even at n³ triples.
+    i3, j3, k3 = (m.ravel() for m in np.meshgrid(
+        np.arange(n), np.arange(n), np.arange(n), indexing="ij"))
+    rows3 = n * n * n
+    bad3 = None
+    for lo in range(0, rows3, _CHUNK):
+        sel = np.arange(lo, min(lo + _CHUNK, rows3))
+        left = vjoin(_gather(jab, i3[sel] * n + j3[sel]), _gather(S, k3[sel]))
+        right = vjoin(_gather(S, i3[sel]), _gather(jab, j3[sel] * n + k3[sel]))
+        r = _first_bad(_rows_equal(left, right, sel.size))
+        if r is not None:
+            bad3 = (int(sel[r]),
+                    summarize_state(_gather(left, r)),
+                    summarize_state(_gather(right, r)))
+            break
+    laws["associative"] = _law(
+        bad3 is None, rows3,
+        None if bad3 is None else {
+            "a": summarize_state(dom.states[int(i3[bad3[0]])]),
+            "b": summarize_state(dom.states[int(j3[bad3[0]])]),
+            "c": summarize_state(dom.states[int(k3[bad3[0]])]),
+            "lhs": bad3[1],
+            "rhs": bad3[2],
+        })
+
+    # inflationary: a ≤ join(a,b) and b ≤ join(a,b), where x ≤ y is the
+    # join-characterized order join(x,y) == y
+    a_le = vjoin(A, jab)
+    b_le = vjoin(B, jab)
+    eq = _rows_equal(a_le, jab, n * n) & _rows_equal(b_le, jab, n * n)
+    r = _first_bad(eq)
+    laws["inflationary"] = _law(
+        r is None, n * n,
+        None if r is None else _pair_ce(dom, ii, jj, r, a_le, jab))
+
+    return laws
+
+
+# ---- combinator obligations -------------------------------------------------
+
+
+def _obligation(holds: bool, space: int, counterexample=None) -> dict:
+    return _law(holds, space, counterexample)
+
+
+def _semidirect_obligations(spec, registry, cap: int) -> Dict[str, dict]:
+    import jax
+
+    from crdt_tpu.ops import algebra
+
+    act = algebra.act_of(spec.name)
+    if act is None:
+        return {"act-laws": {
+            "holds": False, "space": 0,
+            "skipped": "no act registered in the algebra side table"}}
+    a_spec = registry[spec.parts[0]]
+    b_spec = registry[spec.parts[1]]
+    # part domains capped tighter: the obligations sweep nA³ × nB rows
+    dom_a = build_domain(a_spec, cap=min(cap, 12))
+    dom_b = build_domain(b_spec, cap=min(cap, 12))
+    na, nb = len(dom_a.states), len(dom_b.states)
+    A, B = stack(dom_a.states), stack(dom_b.states)
+    vact = jax.jit(jax.vmap(act))
+    vjoin_a = jax.jit(jax.vmap(a_spec.join))
+    vjoin_b = jax.jit(jax.vmap(b_spec.join))
+    out: Dict[str, dict] = {}
+
+    # identity: act(f, f, x) == x
+    fi, xi = (m.ravel() for m in np.meshgrid(
+        np.arange(na), np.arange(nb), indexing="ij"))
+    F, X = _gather(A, fi), _gather(B, xi)
+    got = vact(F, F, X)
+    eq = _rows_equal(got, X, na * nb)
+    r = _first_bad(eq)
+    out["act-identity"] = _obligation(
+        r is None, na * nb,
+        None if r is None else {
+            "frame": summarize_state(dom_a.states[int(fi[r])]),
+            "b": summarize_state(dom_b.states[int(xi[r])]),
+            "lhs": summarize_state(_gather(got, r)),
+            "rhs": summarize_state(dom_b.states[int(xi[r])]),
+        })
+
+    # composition over join-generated monotone chains f1 ≤ f12 ≤ f123:
+    # act(f123, f12, act(f12, f1, x)) == act(f123, f1, x)
+    i3, j3, k3, x3 = (m.ravel() for m in np.meshgrid(
+        np.arange(na), np.arange(na), np.arange(na), np.arange(nb),
+        indexing="ij"))
+    rows = i3.size
+    F1 = _gather(A, i3)
+    F12 = _chunked(vjoin_a, rows, F1, _gather(A, j3))
+    F123 = _chunked(vjoin_a, rows, F12, _gather(A, k3))
+    X3 = _gather(B, x3)
+    step = _chunked(vact, rows, F12, F1, X3)
+    lhs = _chunked(vact, rows, F123, F12, step)
+    rhs = _chunked(vact, rows, F123, F1, X3)
+    eq = _rows_equal(lhs, rhs, rows)
+    r = _first_bad(eq)
+    out["act-composition"] = _obligation(
+        r is None, rows,
+        None if r is None else {
+            "f1": summarize_state(dom_a.states[int(i3[r])]),
+            "b": summarize_state(dom_b.states[int(x3[r])]),
+            "lhs": summarize_state(_gather(lhs, r)),
+            "rhs": summarize_state(_gather(rhs, r)),
+        })
+
+    # join-homomorphism for f ≥ g (g = A[i], f = g ∨ A[j]):
+    # act(f, g, x ∨ y) == act(f, g, x) ∨ act(f, g, y)
+    gi, fj, xi2, yi2 = (m.ravel() for m in np.meshgrid(
+        np.arange(na), np.arange(na), np.arange(nb), np.arange(nb),
+        indexing="ij"))
+    rows = gi.size
+    G = _gather(A, gi)
+    F = _chunked(vjoin_a, rows, G, _gather(A, fj))
+    X2, Y2 = _gather(B, xi2), _gather(B, yi2)
+    xy = _chunked(vjoin_b, rows, X2, Y2)
+    lhs = _chunked(vact, rows, F, G, xy)
+    rhs = _chunked(vjoin_b, rows,
+                   _chunked(vact, rows, F, G, X2),
+                   _chunked(vact, rows, F, G, Y2))
+    eq = _rows_equal(lhs, rhs, rows)
+    r = _first_bad(eq)
+    out["act-join-homomorphism"] = _obligation(
+        r is None, rows,
+        None if r is None else {
+            "g": summarize_state(dom_a.states[int(gi[r])]),
+            "x": summarize_state(dom_b.states[int(xi2[r])]),
+            "y": summarize_state(dom_b.states[int(yi2[r])]),
+            "lhs": summarize_state(_gather(lhs, r)),
+            "rhs": summarize_state(_gather(rhs, r)),
+        })
+    return out
+
+
+def _lexicographic_obligations(spec, registry, cap: int) -> Dict[str, dict]:
+    import jax
+
+    from crdt_tpu.ops import algebra
+
+    rank = algebra.rank_of(spec.name)
+    if rank is None:
+        return {"rank-chain": {
+            "holds": False, "space": 0,
+            "skipped": "no rank registered in the algebra side table"}}
+    a_spec = registry[spec.parts[0]]
+    dom_a = build_domain(a_spec, cap=cap)
+    na = len(dom_a.states)
+    ranks = np.asarray(jax.vmap(rank)(stack(dom_a.states))).reshape(na, -1)
+    keys = [dom_mod.state_key(s) for s in dom_a.states]
+    bad = None
+    for i in range(na):
+        for j in range(i + 1, na):
+            if (ranks[i] == ranks[j]).all() and keys[i] != keys[j]:
+                bad = (i, j)
+                break
+        if bad:
+            break
+    out = _obligation(
+        bad is None, na * (na - 1) // 2,
+        None if bad is None else {
+            "a": summarize_state(dom_a.states[bad[0]]),
+            "b": summarize_state(dom_a.states[bad[1]]),
+            "rank": ranks[bad[0]].tolist(),
+        })
+    return {"rank-chain": out}
+
+
+def combinator_obligations(spec, registry,
+                           cap: int = DEFAULT_CAP) -> Dict[str, dict]:
+    if spec.combinator == "semidirect":
+        return _semidirect_obligations(spec, registry, cap)
+    if spec.combinator == "lexicographic":
+        return _lexicographic_obligations(spec, registry, cap)
+    return {}
+
+
+# ---- whole-spec verdict -----------------------------------------------------
+
+
+def prove_spec(spec, registry=None, cap: int = DEFAULT_CAP) -> dict:
+    """Blast one join: domain, five laws, combinator obligations.
+
+    Returns the ledger entry body (verdict/laws/domain/obligations/...).
+    The verdict here is LOCAL — ``proved`` / ``refuted`` / ``assumed``
+    from this join's own evidence; the ledger layer downgrades composite
+    ``proved`` to ``assumed`` when a part is not itself proved.
+    """
+    global _BLAST_CALLS
+    _BLAST_CALLS += 1
+    if registry is None:
+        from crdt_tpu.ops.joins import registered_joins
+
+        registry = registered_joins()
+
+    dom = build_domain(spec, cap=cap)
+    if not dom.states:
+        return {
+            "verdict": "assumed",
+            "reason": ("no domain: join registered neither small, rand, "
+                       "nor neutral metadata"),
+            "laws": {},
+            "domain": {"states": 0, "closed": False, "source": dom.source},
+            "obligations": {},
+        }
+    laws = check_laws(spec, dom)
+    obligations = combinator_obligations(spec, registry, cap)
+
+    refuted_laws = [k for k, v in laws.items() if not v["holds"]]
+    refuted_obls = [k for k, v in obligations.items() if not v["holds"]]
+    if refuted_laws or refuted_obls:
+        verdict, reason = "refuted", None
+    elif not dom.closed:
+        verdict = "assumed"
+        reason = (f"domain closure capped at {len(dom.states)} states "
+                  f"(cap={cap}); all laws hold on the sampled subspace "
+                  f"but it is not a closed sub-semilattice")
+    else:
+        verdict, reason = "proved", None
+
+    entry = {
+        "verdict": verdict,
+        "laws": laws,
+        "domain": {
+            "states": len(dom.states),
+            "closed": bool(dom.closed),
+            "source": dom.source,
+            "closure_rounds": dom.rounds,
+        },
+        "obligations": obligations,
+    }
+    if reason:
+        entry["reason"] = reason
+    if refuted_laws:
+        entry["refuted_laws"] = refuted_laws
+    if refuted_obls:
+        entry["refuted_obligations"] = refuted_obls
+    return entry

@@ -93,6 +93,9 @@ class LocalCluster:
         # background-gossip failures: recorded here and re-raised by stop().
         # The reference's gossip goroutine dies silently forever on one bad
         # payload (quirk §0.1.8); here a dead loop is always surfaced.
+        # Appends run on per-replica loop threads, reads on the caller's —
+        # both sides take the lock.
+        self._err_lock = threading.Lock()
         self.errors: List[Exception] = []
 
     # ---- addressing (reference topology: ports) ----
@@ -366,10 +369,13 @@ class LocalCluster:
         for t in self._threads:
             t.join(timeout=5)
         self._threads.clear()
-        if self.errors:
+        with self._err_lock:
+            n_dead = len(self.errors)
+            first = self.errors[0] if self.errors else None
+        if first is not None:
             raise RuntimeError(
-                f"{len(self.errors)} background gossip loop(s) died"
-            ) from self.errors[0]
+                f"{n_dead} background gossip loop(s) died"
+            ) from first
 
     def _loop(self, idx: int) -> None:
         """Background pull loop for one replica.  The 0th replica's loop
@@ -394,5 +400,6 @@ class LocalCluster:
                     self.seq_collect()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.metrics.inc("gossip_loop_errors")
-                self.errors.append(e)
+                with self._err_lock:
+                    self.errors.append(e)
                 raise

@@ -142,6 +142,12 @@ class RemotePeer:
         with self._backoff_lock:
             return self._state
 
+    def failure_count(self) -> int:
+        """Transport-failure count, read under the backoff lock (writers
+        run on gossip/fetch threads; observers must not read it bare)."""
+        with self._backoff_lock:
+            return self.failures
+
     def _get(self, path: str,
              headers: Optional[Dict[str, str]] = None) -> Optional[bytes]:
         req = urllib.request.Request(self.url + path, headers=headers or {})
@@ -499,6 +505,9 @@ class NetworkAgent:
         self._rng = random.Random(self.config.seed if seed is None else seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # gossip-loop failures: appended from the loop thread, read by
+        # stop() on the caller's thread — lock both sides
+        self._err_lock = threading.Lock()
         self.errors: List[Exception] = []
 
     def gossip_once(self) -> bool:
@@ -569,7 +578,7 @@ class NetworkAgent:
             if p.backed_off():
                 self.metrics.inc("net_peer_backoff_skips")
                 self.node.events.emit("peer_backoff_skip", peer=p.url,
-                                      failures=p.failures,
+                                      failures=p.failure_count(),
                                       circuit=p.circuit_state())
             else:
                 avail.append(p)
@@ -673,7 +682,8 @@ class NetworkAgent:
 
     def start(self) -> None:
         self._stop.clear()
-        self.errors.clear()  # a restart begins a fresh failure record
+        with self._err_lock:
+            self.errors.clear()  # a restart begins a fresh failure record
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -682,8 +692,10 @@ class NetworkAgent:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        if self.errors:
-            raise RuntimeError("network gossip loop died") from self.errors[0]
+        with self._err_lock:
+            first = self.errors[0] if self.errors else None
+        if first is not None:
+            raise RuntimeError("network gossip loop died") from first
 
     def compact_once(self) -> dict:
         """Run one cross-daemon compaction barrier from this agent (must be
@@ -901,7 +913,8 @@ class NetworkAgent:
                     self.map_reset_once()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.metrics.inc("net_gossip_loop_errors")
-                self.errors.append(e)
+                with self._err_lock:
+                    self.errors.append(e)
                 raise
 
 
@@ -1030,6 +1043,9 @@ class NodeHost:
         self._server_thread: Optional[threading.Thread] = None
         self._ckpt_stop = threading.Event()
         self._ckpt_thread: Optional[threading.Thread] = None
+        # checkpoint-loop failures: appended from the ckpt thread, read
+        # by stop() on the caller's thread — lock both sides
+        self._ckpt_err_lock = threading.Lock()
         self._ckpt_errors: List[Exception] = []
 
     def install_flight_recorder(self, ledger=None, step_clock=None) -> None:
@@ -1075,10 +1091,13 @@ class NodeHost:
                 self._ckpt_thread.join(timeout=5)
                 self._ckpt_thread = None
             self.agent.stop()
-            if self._ckpt_errors:
+            with self._ckpt_err_lock:
+                n_failed = len(self._ckpt_errors)
+                first = self._ckpt_errors[0] if self._ckpt_errors else None
+            if first is not None:
                 raise RuntimeError(
-                    f"{len(self._ckpt_errors)} periodic checkpoint(s) failed"
-                ) from self._ckpt_errors[0]
+                    f"{n_failed} periodic checkpoint(s) failed"
+                ) from first
         finally:
             self.stop_server()
 
@@ -1091,7 +1110,8 @@ class NodeHost:
                 self.checkpoint_now()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.agent.metrics.inc("checkpoint_errors")
-                self._ckpt_errors.append(e)
+                with self._ckpt_err_lock:
+                    self._ckpt_errors.append(e)
 
     # ---- admin drive surface (POST /admin/*, crash-soak determinism) ----
 

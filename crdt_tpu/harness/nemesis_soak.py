@@ -795,7 +795,17 @@ def main(argv=None) -> int:
                          "high-water mark and require every shed to be "
                          "black-boxed 1:1 (client 429s == ingest_shed "
                          "events, down to the op totals)")
+    ap.add_argument("--race-check", action="store_true",
+                    help="run under the witnessed-race detector "
+                         "(analysis.verify.race) and fail on any "
+                         "unsynchronized shared-state access pair")
     args = ap.parse_args(argv)
+    if args.race_check:
+        # install BEFORE any soak/NodeHost construction: threading.Lock
+        # objects created pre-install are invisible to the vector-clock
+        # checker and would surface as false witnesses
+        from crdt_tpu.analysis.verify import race
+        race.install()
     for k in range(args.seeds):
         seed = args.seed_base + k
         if args.replay_check:
@@ -826,6 +836,27 @@ def main(argv=None) -> int:
                            composite=args.composite,
                            overload=args.overload)
             print(f"[nemesis] {rep.summary()}")
+        if args.race_check:
+            rpt = race.report()
+            reads = sum(c["reads"] for c in rpt["access_counts"].values())
+            writes = sum(c["writes"] for c in rpt["access_counts"].values())
+            # a race-check that observed no traffic proves nothing — the
+            # watchpoints must have been exercised by the run
+            assert reads + writes > 0, (
+                "race detector observed zero watched accesses: "
+                "instrumentation dead or watch list empty"
+            )
+            if rpt["witness_count"]:
+                for w in rpt["witnesses"]:
+                    print(w)
+                raise AssertionError(
+                    f"seed {seed}: {rpt['witness_count']} witnessed "
+                    f"race(s) on shared runtime state (above)"
+                )
+            print(f"[nemesis] race-check OK: 0 witnesses over "
+                  f"{reads} reads / {writes} writes across "
+                  f"{len(rpt['access_counts'])} watchpoints")
+            race.reset()
     return 0
 
 

@@ -110,8 +110,11 @@ class AdmissionQueue:
 
     @property
     def depth(self) -> int:
-        """Pending (undrained) op count — the ingest_queue_depth gauge."""
-        return self._depth
+        """Pending (undrained) op count — the ingest_queue_depth gauge.
+        Read under the queue lock: writers are submitter/drain threads
+        and a torn read here feeds the shed policy and the gauge."""
+        with self._lock:
+            return self._depth
 
     def submit_many(self, items: Sequence[Any]) -> Ticket:
         """Enqueue a group of ops atomically (one page = one group =

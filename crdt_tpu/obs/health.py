@@ -163,13 +163,29 @@ def sample_peer_circuits(registry, node_label: str, peers) -> None:
         registry.set_gauge("net_peer_circuit_state",
                            CIRCUIT_STATE_VALUE.get(state, 2),
                            node=node_label, peer=p.url)
-        registry.set_gauge("net_peer_failures", p.failures,
+        registry.set_gauge("net_peer_failures", p.failure_count(),
                            node=node_label, peer=p.url)
         if state != "closed":
             unreachable += 1
     registry.set_gauge("net_peers_unreachable", unreachable,
                        node=node_label)
     registry.set_gauge("net_peers_total", len(peers), node=node_label)
+
+
+def sample_race_watch(registry) -> None:
+    """Witnessed-race detector gauges (analysis.verify.race): the current
+    witness count plus per-watchpoint read/write traffic, so a soak run
+    can prove the instrumentation was LIVE (zero witnesses over zero
+    observed accesses proves nothing).  No-op when the detector is not
+    installed."""
+    from crdt_tpu.analysis.verify import race
+
+    registry.set_gauge("race_witnesses", float(len(race.witnesses())))
+    for attr, counts in sorted(race.access_counts().items()):
+        registry.set_gauge("race_watch_reads", float(counts["reads"]),
+                           attr=attr)
+        registry.set_gauge("race_watch_writes", float(counts["writes"]),
+                           attr=attr)
 
 
 def sample_all(registry, node, set_node=None, seq_node=None,

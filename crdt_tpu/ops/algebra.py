@@ -51,12 +51,29 @@ not provable statically) for ``semidirect(a, act, b)`` to be a lattice:
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple, Union
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
 from crdt_tpu.ops.joins import JoinSpec, register_join, registered_joins
+
+# side tables keyed by composite name: the act / rank callables are not
+# part of the jaxpr-traceable JoinSpec surface, but the prover
+# (crdt_tpu.analysis.verify) needs them to discharge combinator-specific
+# obligations (semidirect act laws, lexicographic rank-chain)
+_ACTS: Dict[str, Callable[[Any, Any, Any], Any]] = {}
+_RANKS: Dict[str, Callable[[Any], Any]] = {}
+
+
+def act_of(name: str) -> Optional[Callable[[Any, Any, Any], Any]]:
+    """The ``act`` callable a semidirect composite was built with."""
+    return _ACTS.get(name)
+
+
+def rank_of(name: str) -> Optional[Callable[[Any], Any]]:
+    """The ``rank`` callable a lexicographic composite was built with."""
+    return _RANKS.get(name)
 
 
 def resolve(spec: Union[JoinSpec, str]) -> JoinSpec:
@@ -121,6 +138,7 @@ def product(a: Union[JoinSpec, str], b: Union[JoinSpec, str], *,
         neutral=neutral,
         rand=_derived_rand(rand, a, b),
         parts=(a.name, b.name),
+        combinator="product",
     )
 
 
@@ -164,7 +182,7 @@ def lexicographic(a: Union[JoinSpec, str], b: Union[JoinSpec, str],
     def rand(rng) -> Pair:
         return Pair(fst=a.rand(rng), snd=b.rand(rng))
 
-    return register_join(
+    spec = register_join(
         name, join,
         lambda: (Pair(fst=a.example()[0], snd=b.example()[0]),
                  Pair(fst=a.example()[1], snd=b.example()[1])),
@@ -172,7 +190,10 @@ def lexicographic(a: Union[JoinSpec, str], b: Union[JoinSpec, str],
         neutral=neutral,
         rand=_derived_rand(rand, a, b),
         parts=(a.name, b.name),
+        combinator="lexicographic",
     )
+    _RANKS[name] = rank
+    return spec
 
 
 # ---- mapof ------------------------------------------------------------------
@@ -227,6 +248,7 @@ def mapof(inner: Union[JoinSpec, str], *, n_keys: int = 4,
         neutral=neutral,
         rand=_derived_rand(rand, inner),
         parts=(inner.name,),
+        combinator="mapof",
     )
 
 
@@ -267,7 +289,7 @@ def semidirect(a: Union[JoinSpec, str],
     def rand(rng) -> Pair:
         return Pair(fst=a.rand(rng), snd=b.rand(rng))
 
-    return register_join(
+    spec = register_join(
         name, join,
         lambda: (Pair(fst=a.example()[0], snd=b.example()[0]),
                  Pair(fst=a.example()[1], snd=b.example()[1])),
@@ -275,4 +297,7 @@ def semidirect(a: Union[JoinSpec, str],
         neutral=neutral,
         rand=_derived_rand(rand, a, b),
         parts=(a.name, b.name),
+        combinator="semidirect",
     )
+    _ACTS[name] = act
+    return spec

@@ -187,7 +187,21 @@ class JoinSpec:
       — the fuel of the registry-wide ACI law sweep;
     * ``parts`` names the registered joins a composite was built from
       (empty for leaves); crdtlint's CRDT104 checks metadata propagation
-      against it.
+      against it;
+    * ``small`` returns a deterministic list of tiny reachable seed
+      states — the prover (crdt_tpu.analysis.verify) closes them under
+      the join and exhaustively checks the lattice laws over the full
+      product space.  Omitted, the prover falls back to seeded ``rand``
+      draws;
+    * ``combinator`` names the algebra combinator that built a composite
+      ("product" / "lexicographic" / "mapof" / "semidirect"; empty for
+      leaves) so the prover can discharge combinator-specific
+      obligations (semidirect act laws, lexicographic rank-chain);
+    * ``verified`` is None until the crdtprove ledger is consulted, then
+      True iff every lattice law is machine-verified ``proved`` for this
+      join (see :func:`verified_joins`) — the field the stability-
+      frontier GC and strong-read work can require before trusting a
+      join to be inflationary.
     """
 
     name: str
@@ -197,6 +211,9 @@ class JoinSpec:
     neutral: Optional[Callable[[], Any]] = None
     rand: Optional[Callable[[Any], Any]] = None
     parts: Tuple[str, ...] = ()
+    small: Optional[Callable[[], Any]] = None
+    combinator: str = ""
+    verified: Optional[bool] = dataclasses.field(default=None, compare=False)
 
 
 _JOIN_REGISTRY: Dict[str, JoinSpec] = {}
@@ -208,7 +225,9 @@ def register_join(name: str, join_fn: Callable,
                   structurally_commutative: bool = False,
                   neutral: Optional[Callable[[], Any]] = None,
                   rand: Optional[Callable[[Any], Any]] = None,
-                  parts: Tuple[str, ...] = ()) -> JoinSpec:
+                  parts: Tuple[str, ...] = (),
+                  small: Optional[Callable[[], Any]] = None,
+                  combinator: str = "") -> JoinSpec:
     """Register a lattice join for the static ACI/purity gate.  ``example``
     builds a concrete (a, b) operand pair; only its avals are used.  When
     omitted it defaults to a pair of ``neutral`` elements (one of the two
@@ -222,9 +241,33 @@ def register_join(name: str, join_fn: Callable,
         example = lambda: (neutral(), neutral())  # noqa: E731
     spec = JoinSpec(name=name, join=join_fn, example=example,
                     structurally_commutative=structurally_commutative,
-                    neutral=neutral, rand=rand, parts=tuple(parts))
+                    neutral=neutral, rand=rand, parts=tuple(parts),
+                    small=small, combinator=combinator)
     _JOIN_REGISTRY[name] = spec
     return spec
+
+
+def mark_verified(name: str, verified: bool) -> None:
+    """Stamp a registered join's ``verified`` field from the crdtprove
+    ledger (crdt_tpu.analysis.verify.ledger.annotate_registry is the only
+    intended caller — ops stays free of analysis imports; the analysis
+    layer pushes its verdicts in)."""
+    spec = _JOIN_REGISTRY.get(name)
+    if spec is not None:
+        object.__setattr__(spec, "verified", bool(verified))
+
+
+def verified_joins() -> Dict[str, JoinSpec]:
+    """Name → JoinSpec for every registered join whose lattice laws are
+    machine-verified ``proved`` in the committed crdtprove ledger
+    (crdt_tpu/analysis/verdicts.json).  The stability-frontier GC and
+    strong-read layers should draw joins from here: a join outside this
+    dict has no machine-checked inflationarity guarantee."""
+    from crdt_tpu.analysis.verify import ledger
+
+    registry = registered_joins()
+    ledger.annotate_registry()
+    return {n: s for n, s in registry.items() if s.verified}
 
 
 def registered_joins() -> Dict[str, JoinSpec]:
@@ -258,16 +301,20 @@ def _register_builtin_joins() -> None:
     register_join("gcounter", gcounter.join,
                   neutral=lambda: gcounter.zero(8),
                   rand=rs.rand_gcounter,
+                  small=rs.small_gcounter,
                   structurally_commutative=True)
     register_join("pncounter", pncounter.join,
                   neutral=lambda: pncounter.zero(8),
                   rand=rs.rand_pncounter,
+                  small=rs.small_pncounter,
                   structurally_commutative=True)
     register_join("lww", lww.join,
-                  neutral=lww.zero, rand=rs.rand_lww)
+                  neutral=lww.zero, rand=rs.rand_lww,
+                  small=rs.small_lww)
     register_join("lww_packed", lww.join_packed,
                   neutral=lambda: lww.pack(lww.zero()),
-                  rand=rs.rand_lww_packed)
+                  rand=rs.rand_lww_packed,
+                  small=rs.small_lww_packed)
     register_join("mvregister", mvregister.join,
                   neutral=lambda: mvregister.zero(4),
                   rand=rs.rand_mvregister)
@@ -285,22 +332,31 @@ def _register_builtin_joins() -> None:
                   structurally_commutative=True)
     register_join("gset", gset.g_join,
                   neutral=lambda: gset.g_empty(16),
-                  rand=rs.rand_gset)
+                  rand=rs.rand_gset,
+                  small=rs.small_gset)
     register_join("twopset", gset.tp_join,
                   neutral=lambda: gset.tp_empty(16),
-                  rand=rs.rand_twopset)
+                  rand=rs.rand_twopset,
+                  small=rs.small_twopset)
+    # sorted fixed-capacity family: small = fixed-seed draws at a fill
+    # tight enough that the UNION of all seeds stays within capacity
+    # (capacity-headroom rule — closure overflow is lossy, not a law bug)
     register_join("orset", orset.join,
                   neutral=lambda: orset.empty(16),
-                  rand=rs.rand_orset)
+                  rand=rs.rand_orset,
+                  small=lambda: rs.small_seeded(rs.rand_orset, fill=2))
     register_join("rseq", rseq.join,
                   neutral=lambda: rseq.empty(16),
-                  rand=rs.rand_rseq)
+                  rand=rs.rand_rseq,
+                  small=lambda: rs.small_seeded(rs.rand_rseq, fill=2))
     register_join("oplog", oplog.merge,
                   neutral=lambda: oplog.empty(32),
-                  rand=rs.rand_oplog)
+                  rand=rs.rand_oplog,
+                  small=lambda: rs.small_seeded(rs.rand_oplog, fill=3))
     register_join("compactlog", compactlog.merge,
                   neutral=lambda: compactlog.empty(32, 8, 4),
-                  rand=rs.rand_compactlog)
+                  rand=rs.rand_compactlog,
+                  small=lambda: rs.small_seeded(rs.rand_compactlog, fill=3))
 
     # derived composite models (crdt_tpu.models.composite) register through
     # the combinator layer (crdt_tpu.ops.algebra) — same late import as the

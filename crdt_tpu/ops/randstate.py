@@ -21,6 +21,8 @@ Two soundness rules keep independently drawn states mutually consistent
 """
 from __future__ import annotations
 
+import itertools
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -212,7 +214,7 @@ def rand_oplog(rng, capacity: int = 32, fill: int = 10, n_keys: int = 6,
 
 
 def rand_compactlog(rng, capacity: int = 32, n_keys: int = 8,
-                    n_writers: int = 4):
+                    n_writers: int = 4, fill: int = 10):
     from crdt_tpu.models import compactlog
 
     # frontier = -1 everywhere (nothing folded): merge's adopt-the-larger
@@ -220,9 +222,111 @@ def rand_compactlog(rng, capacity: int = 32, n_keys: int = 8,
     # can run on independently drawn states (non-trivial frontiers require
     # the swarm's chain-ordering protocol to be law-abiding)
     return compactlog.fresh(
-        rand_oplog(rng, capacity=capacity, n_keys=n_keys, n_rids=n_writers),
+        rand_oplog(rng, capacity=capacity, fill=fill, n_keys=n_keys,
+                   n_rids=n_writers),
         n_keys, n_writers,
     )
+
+
+# ---- deterministic tiny seed domains (crdtprove) ---------------------------
+#
+# Each ``small_*`` returns a LIST of tiny reachable states at the SAME avals
+# as the registered neutral: the prover (crdt_tpu.analysis.verify) stacks
+# neutral + seeds + their join closure into one vmapped product sweep and
+# checks the lattice laws exhaustively over it.  The capacity-headroom rule
+# applies across the WHOLE list for sorted fixed-capacity lattices: the
+# union of every seed's keys must fit in capacity, or the closure overflows
+# and drops keys — a soundness bug in the prover's domain, not a law
+# violation in the lattice.
+
+
+def small_gcounter(n_nodes: int = 8, vals=(0, 1, 2), slots: int = 2):
+    """Every counts-vector over ``vals`` on the first ``slots`` coordinates
+    (rest zero): the complete ``slots``-node instance embedded at the
+    registered shape."""
+    from crdt_tpu.models import gcounter
+
+    out = []
+    for combo in itertools.product(vals, repeat=slots):
+        counts = [0] * n_nodes
+        counts[:slots] = combo
+        out.append(gcounter.GCounter(counts=jnp.asarray(counts, jnp.int32)))
+    return out
+
+
+def small_pncounter(n_nodes: int = 8, vals=(0, 1), slots: int = 2):
+    from crdt_tpu.models import pncounter
+
+    out = []
+    for pos in itertools.product(vals, repeat=slots):
+        for neg in itertools.product(vals, repeat=slots):
+            p = [0] * n_nodes
+            n = [0] * n_nodes
+            p[:slots] = pos
+            n[:slots] = neg
+            out.append(pncounter.PNCounter(
+                pos=jnp.asarray(p, jnp.int32),
+                neg=jnp.asarray(n, jnp.int32),
+            ))
+    return out
+
+
+def small_lww():
+    """zero plus every write with ts in {0,1,2} x rid in {0,1}
+    (payload-from-identity keeps independent seeds consistent)."""
+    from crdt_tpu.models import lww
+
+    out = [lww.zero()]
+    for ts in (0, 1, 2):
+        for rid in (0, 1):
+            out.append(lww.LWWRegister(
+                ts=jnp.asarray(ts, jnp.int32),
+                rid=jnp.asarray(rid, jnp.int32),
+                payload=jnp.asarray(_lww_payload(ts, rid), jnp.int32),
+            ))
+    return out
+
+
+def small_lww_packed():
+    from crdt_tpu.models import lww
+
+    return [lww.pack(s) for s in small_lww()]
+
+
+def small_gset(capacity: int = 16, universe=(3, 7, 11)):
+    """Every subset of a tiny universe — the complete powerset lattice."""
+    from crdt_tpu.models import gset
+
+    out = []
+    for r in range(len(universe) + 1):
+        for subset in itertools.combinations(universe, r):
+            out.append(gset.GSet(elem=_sorted_pad(list(subset), capacity)))
+    return out
+
+
+def small_twopset(capacity: int = 16, universe=(3, 7)):
+    """Every element independently absent / present-live / present-removed
+    — the complete two-phase lattice over a tiny universe."""
+    from crdt_tpu.models import gset
+
+    out = []
+    for states in itertools.product((0, 1, 2), repeat=len(universe)):
+        elems = [e for e, s in zip(universe, states) if s]
+        removed = [s == 2 for s in states if s]
+        pad = [False] * (capacity - len(elems))
+        out.append(gset.TwoPSet(
+            elem=_sorted_pad(elems, capacity),
+            removed=jnp.asarray(removed + pad, bool),
+        ))
+    return out
+
+
+def small_seeded(rand_fn, n: int = 5, seed: int = 0, **kw):
+    """Fixed-seed draws from a ``rand_*`` generator — the seed domain for
+    lattices too big to enumerate.  Callers pass a tight ``fill`` so the
+    union of all draws honors the capacity-headroom rule."""
+    rng = np.random.default_rng(seed)
+    return [rand_fn(rng, **kw) for _ in range(n)]
 
 
 BUILTIN_RAND = {

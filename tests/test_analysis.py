@@ -308,3 +308,49 @@ def test_cli_check_baseline_exit_codes(tmp_path, monkeypatch):
         "        pass\n")
     assert cli.main([str(bad), "--no-jaxpr", "--check-baseline",
                      "--baseline", str(empty_bl)]) == 1
+
+
+# ------------------------------------------------------------------ SARIF
+
+def test_sarif_output_shape(tmp_path):
+    from crdt_tpu.analysis import __main__ as cli
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def poll(u):\n"
+        "    try:\n"
+        "        fetch(u)\n"
+        "    except Exception:\n"
+        "        pass\n")
+    out = tmp_path / "out.sarif"
+    assert cli.main([str(bad), "--no-jaxpr", "--sarif", str(out)]) == 1
+    import json
+
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "crdtlint"
+    (res,) = run["results"]
+    assert res["ruleId"] == "CRDT004"
+    assert res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] >= 1
+    # annotation identity rides the baseline fingerprint, so it survives
+    # line drift exactly like the suppression ratchet
+    assert res["partialFingerprints"]["crdtlint/v1"]
+    # the referenced rule is declared in the driver's rule table
+    rules = run["tool"]["driver"]["rules"]
+    assert rules[res["ruleIndex"]]["id"] == "CRDT004"
+
+
+def test_hazard_and_verify_rules_are_listed():
+    """CRDT105-107 (semantic hazards) and CRDT301/302 (verify gate) are
+    first-class rules: documented, severity-mapped, CLI-listable."""
+    for rule in ("CRDT105", "CRDT106", "CRDT107", "CRDT301", "CRDT302"):
+        assert rule in analysis.RULES
+    assert analysis.SEVERITY["CRDT105"] == "error"
+    assert analysis.SEVERITY["CRDT106"] == "error"
+    assert analysis.SEVERITY["CRDT107"] == "warn"
+    assert analysis.SEVERITY["CRDT301"] == "error"
+    assert analysis.SEVERITY["CRDT302"] == "error"

@@ -1,0 +1,405 @@
+"""crdtprove self-tests: the bit-blaster refutes planted defective joins
+with exact counterexamples, the committed verdict ledger covers every
+registered join, the fingerprint cache skips unchanged joins (pinned via
+the blast call counter), and the witnessed-race detector catches a
+planted unsynchronized access while staying silent on properly
+synchronized code.
+"""
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+from crdt_tpu.analysis.verify import ledger, prove, race
+from crdt_tpu.analysis.verify.domains import build_domain
+from crdt_tpu.ops.joins import JoinSpec, registered_joins
+
+
+# ------------------------------------------------- planted defective joins
+
+
+def _avg_spec():
+    """Weighted mean masquerading as a join: floats AND asymmetric.
+    Refuted on commutativity (0.6a+0.4b != 0.6b+0.4a whenever a != b)."""
+    def avg_join(a, b):
+        return 0.6 * a + 0.4 * b
+
+    neutral = lambda: jnp.zeros((2,), jnp.float32)  # noqa: E731
+    small = lambda: [jnp.asarray([v, 0.0], jnp.float32)  # noqa: E731
+                     for v in (0.0, 1.0, 2.0)]
+    return JoinSpec("bad_avg", avg_join, lambda: (neutral(), neutral()),
+                    neutral=neutral, small=small)
+
+
+def _sat_spec():
+    """Saturating int8 add: the unsaturated a+b wraps at 127 long before
+    the clamp at 100 can catch it (80+80 -> -96), so idempotence and
+    inflationarity both break with concrete witnesses."""
+    def sat_join(a, b):
+        return jnp.minimum(a + b, jnp.int8(100))
+
+    neutral = lambda: jnp.zeros((2,), jnp.int8)  # noqa: E731
+    small = lambda: [jnp.asarray([v, 0], jnp.int8)  # noqa: E731
+                     for v in (0, 3, 80)]
+    return JoinSpec("bad_sat", sat_join, lambda: (neutral(), neutral()),
+                    neutral=neutral, small=small)
+
+
+def test_prover_refutes_noncommutative_float_join():
+    entry = prove.prove_spec(_avg_spec(), registry={})
+    assert entry["verdict"] == "refuted"
+    assert "commutative" in entry["refuted_laws"]
+    ce = entry["laws"]["commutative"]["counterexample"]
+    # the counterexample is concrete: both operand states and both sides
+    # of the violated equation, leaf-wise
+    assert set(ce) == {"a", "b", "lhs", "rhs"}
+    assert ce["lhs"] != ce["rhs"]
+
+
+def test_prover_refutes_saturating_overflow_join():
+    entry = prove.prove_spec(_sat_spec(), registry={})
+    assert entry["verdict"] == "refuted"
+    assert "idempotent" in entry["refuted_laws"]
+    assert "inflationary" in entry["refuted_laws"]
+    ce = entry["laws"]["idempotent"]["counterexample"]
+    # join(a, a) wrapped: the lhs is NOT the state itself
+    assert ce["lhs"] != ce["rhs"]
+
+
+def test_planted_joins_trip_the_hazard_pass():
+    """The semantic jaxpr layer flags the same two planted joins
+    statically: float accumulation (CRDT105) and narrow-int wrap
+    (CRDT107) — defense in depth ahead of any bit-blasting."""
+    import jax
+
+    from crdt_tpu.analysis.verify import hazards
+
+    spec = _avg_spec()
+    closed = jax.make_jaxpr(spec.join)(*spec.example())
+    rules = {f.rule for f in hazards.check_join_hazards(
+        "bad_avg", spec, closed.jaxpr, "fixture.py", 1)}
+    assert "CRDT105" in rules
+
+    spec = _sat_spec()
+    closed = jax.make_jaxpr(spec.join)(*spec.example())
+    rules = {f.rule for f in hazards.check_join_hazards(
+        "bad_sat", spec, closed.jaxpr, "fixture.py", 1)}
+    assert "CRDT107" in rules
+
+
+def test_real_joins_all_prove():
+    """Spot-check the blaster end-to-end on two real lattices (the full
+    registry sweep lives in the committed ledger, gated by
+    test_committed_ledger_covers_registry)."""
+    registry = registered_joins()
+    for name in ("gcounter", "lww"):
+        entry = prove.prove_spec(registry[name], registry)
+        assert entry["verdict"] == "proved", (name, entry)
+        assert entry["domain"]["closed"]
+        for law, res in entry["laws"].items():
+            assert res["holds"], (name, law)
+
+
+# -------------------------------------------------------- verdict ledger
+
+
+def test_committed_ledger_covers_registry():
+    """The acceptance invariant behind `verify --check-ledger`: every
+    registered join has a matching, non-refuted verdict in the committed
+    analysis/verdicts.json — and in this tree, every one is proved."""
+    led = ledger.load()
+    assert led is not None, "analysis/verdicts.json missing"
+    problems, _stale = ledger.check(led)
+    assert problems == []
+    entries = led["joins"]
+    registry = registered_joins()
+    assert set(registry) <= set(entries)
+    for name in registry:
+        e = entries[name]
+        assert e["verdict"] in ("proved", "assumed"), (name, e["verdict"])
+        if e["verdict"] == "assumed":
+            assert e.get("reason"), f"{name}: assumed without a reason"
+        else:
+            assert e["domain"]["closed"], name
+
+
+def test_verified_joins_reflects_ledger():
+    """ops.joins.verified_joins() is the consumer surface: proved +
+    fingerprint-fresh entries mark the spec verified."""
+    from crdt_tpu.ops.joins import verified_joins
+
+    verified = verified_joins()
+    assert set(verified) == set(registered_joins())
+    assert all(s.verified for s in verified.values())
+
+
+def _tiny_registry():
+    def jmax(a, b):
+        return jnp.maximum(a, b)
+
+    def jor(a, b):
+        return jnp.logical_or(a, b)
+
+    zi = lambda: jnp.zeros((2,), jnp.int32)  # noqa: E731
+    zb = lambda: jnp.zeros((2,), bool)  # noqa: E731
+    return {
+        "tmax": JoinSpec("tmax", jmax, lambda: (zi(), zi()), neutral=zi,
+                         small=lambda: [jnp.asarray([v, 0], jnp.int32)
+                                        for v in (1, 2)]),
+        "tor": JoinSpec("tor", jor, lambda: (zb(), zb()), neutral=zb,
+                        small=lambda: [jnp.asarray([True, False])]),
+    }
+
+
+def test_ledger_cache_skips_unchanged_joins():
+    reg = _tiny_registry()
+    led, recomputed = ledger.compute(registry=reg)
+    assert sorted(recomputed) == ["tmax", "tor"]
+    assert all(e["verdict"] == "proved" for e in led["joins"].values())
+
+    # unchanged fingerprints: a cached recompute blasts NOTHING
+    before = prove.blast_call_count()
+    led2, recomputed = ledger.compute(cached=led, registry=reg)
+    assert recomputed == []
+    assert prove.blast_call_count() == before
+    assert led2["joins"] == led["joins"]
+
+    # a drifted fingerprint invalidates exactly that join
+    led["joins"]["tmax"]["fingerprint"] = "0" * 16
+    _led3, recomputed = ledger.compute(cached=led, registry=reg)
+    assert recomputed == ["tmax"]
+    assert prove.blast_call_count() == before + 1
+
+
+def test_fingerprint_tracks_join_body():
+    zi = lambda: jnp.zeros((2,), jnp.int32)  # noqa: E731
+    a = JoinSpec("t", lambda a, b: jnp.maximum(a, b),
+                 lambda: (zi(), zi()), neutral=zi)
+    b = JoinSpec("t", lambda a, b: jnp.minimum(a, b),
+                 lambda: (zi(), zi()), neutral=zi)
+    c = JoinSpec("t", lambda a, b: jnp.maximum(a, b),
+                 lambda: (zi(), zi()), neutral=zi)
+    assert prove.join_fingerprint(a) != prove.join_fingerprint(b)
+    assert prove.join_fingerprint(a) == prove.join_fingerprint(c)
+
+
+def test_composite_verdict_downgrades_with_weak_part():
+    """A composite's `proved` is conditional on its parts: the ledger
+    downgrade pass turns it `assumed` when a part is not proved."""
+    entries = {
+        "leaf": {"verdict": "assumed", "parts": [],
+                 "reason": "domain capped"},
+        "comp": {"verdict": "proved", "parts": ["leaf"]},
+    }
+    ledger._downgrade_composites(entries)
+    assert entries["comp"]["verdict"] == "assumed"
+    assert "leaf" in entries["comp"]["reason"]
+
+
+def test_domain_closure_is_exhaustive():
+    """The soundness backbone: a closed domain really is join-closed, so
+    a quantifier over it is a theorem about the sub-semilattice."""
+    from crdt_tpu.analysis.verify.domains import state_key
+
+    reg = registered_joins()
+    dom = build_domain(reg["gcounter"])
+    assert dom.closed
+    keys = {state_key(s) for s in dom.states}
+    for a in dom.states:
+        for b in dom.states:
+            assert state_key(reg["gcounter"].join(a, b)) in keys
+
+
+# ------------------------------------------------------ verify CLI matrix
+
+
+def test_verify_cli_exit_codes(tmp_path, monkeypatch):
+    from crdt_tpu.analysis import __main__ as cli
+    from crdt_tpu.ops import joins as joins_mod
+
+    reg = _tiny_registry()
+    monkeypatch.setattr(joins_mod, "registered_joins", lambda: reg)
+    lp = tmp_path / "verdicts.json"
+
+    # no ledger yet: the gate is red, a recompute is green
+    assert cli.main(["verify", "--check-ledger", "--ledger", str(lp)]) == 1
+    assert cli.main(["verify", "--write-ledger", "--ledger", str(lp)]) == 0
+    assert lp.exists()
+    assert cli.main(["verify", "--check-ledger", "--ledger", str(lp)]) == 0
+
+    # a refuted join fails both the recompute and the gate, and the
+    # SARIF export carries the CRDT301 result
+    reg["bad_sat"] = _sat_spec()
+    sarif_path = tmp_path / "out.sarif"
+    assert cli.main(["verify", "--write-ledger", "--ledger", str(lp)]) == 1
+    assert cli.main(["verify", "--check-ledger", "--ledger", str(lp),
+                     "--sarif", str(sarif_path)]) == 1
+    import json
+
+    doc = json.loads(sarif_path.read_text())
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert any(r["ruleId"] == "CRDT301" for r in results)
+
+    # dropping the bad join leaves a stale entry, which must NOT fail
+    del reg["bad_sat"]
+    assert cli.main(["verify", "--check-ledger", "--ledger", str(lp)]) == 0
+
+    # body drift (same name, different computation) re-reddens the gate
+    reg["tmax"] = JoinSpec(
+        "tmax", lambda a, b: jnp.maximum(a, b) + 1,
+        reg["tmax"].example, neutral=reg["tmax"].neutral)
+    assert cli.main(["verify", "--check-ledger", "--ledger", str(lp)]) == 1
+
+
+# -------------------------------------------------- witnessed-race checker
+
+
+class _Box:
+    def __init__(self):
+        self.val = 0
+        self.items = []
+
+
+def _hammer(box, n=200):
+    for _ in range(n):
+        box.val += 1
+        box.items.append(1)
+
+
+def test_race_detector_catches_planted_race():
+    assert race.install(watch=[(_Box, "val"), (_Box, "items")]) > 0
+    try:
+        box = _Box()
+        ts = [threading.Thread(target=_hammer, args=(box,))
+              for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        ws = race.witnesses()
+        assert ws, "two unsynchronized writers produced no witness"
+        w = ws[0]
+        assert w.cls == "_Box"
+        assert w.attr in ("val", "items")
+        # the witness is actionable: both stacks point at the fixture
+        assert any("_hammer" in line for line in w.prior_stack)
+        assert any("_hammer" in line for line in w.current_stack)
+        counts = race.access_counts()
+        assert counts["_Box.val"]["writes"] >= 2
+    finally:
+        race.uninstall()
+    # uninstalled objects keep working (stale traced wrappers are inert)
+    box2 = _Box()
+    box2.val = 5
+    box2.items.append(1)
+    assert (box2.val, box2.items) == (5, [1])
+
+
+def test_race_detector_accepts_lock_discipline():
+    assert race.install(watch=[(_Box, "val"), (_Box, "items")]) > 0
+    try:
+        box = _Box()  # built AFTER install: its list is traced
+        lock = threading.Lock()  # likewise: a traced lock
+
+        def worker():
+            for _ in range(200):
+                with lock:
+                    box.val += 1
+                    box.items.append(1)
+
+        ts = [threading.Thread(target=worker) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert race.witnesses() == []
+        assert box.val == 400
+        # and the instrumentation was demonstrably live
+        assert race.access_counts()["_Box.val"]["writes"] >= 400
+    finally:
+        race.uninstall()
+
+
+def test_race_detector_accepts_fork_join_ordering():
+    """start/join edges alone (no lock) are a valid happens-before
+    chain: parent -> child via start, child -> parent via join."""
+    assert race.install(watch=[(_Box, "val")]) > 0
+    try:
+        box = _Box()
+        box.val = 1
+        t = threading.Thread(target=lambda: setattr(box, "val", 2))
+        t.start()
+        t.join()
+        box.val = 3
+        assert race.witnesses() == []
+    finally:
+        race.uninstall()
+
+
+def test_race_detector_accepts_event_handoff():
+    """Event.set/wait is an acquire/release pair: a value published
+    before set() is safely read after wait()."""
+    assert race.install(watch=[(_Box, "val")]) > 0
+    try:
+        box = _Box()
+        ev = threading.Event()
+
+        def producer():
+            box.val = 42
+            ev.set()
+
+        got = []
+
+        def consumer():
+            ev.wait(5.0)
+            got.append(box.val)
+
+        tp = threading.Thread(target=producer)
+        tc = threading.Thread(target=consumer)
+        tc.start()
+        tp.start()
+        tp.join()
+        tc.join()
+        assert got == [42]
+        assert race.witnesses() == []
+    finally:
+        race.uninstall()
+
+
+def test_race_detector_runtime_watchpoints_resolve():
+    """DEFAULT_WATCH must resolve against the live runtime modules — a
+    renamed attr would silently un-instrument the soak."""
+    points = race._resolve_default_watch()
+    assert len(points) >= 7
+    for cls, attr in points:
+        probe = cls.__new__(cls)
+        # the attr is either a slot or assigned in __init__; both
+        # materialize on a constructed instance, which we can't always
+        # build here — so just require the name to be plausible: a slot,
+        # a class attr, or mentioned in __init__
+        import inspect
+
+        src = inspect.getsource(cls.__init__)
+        slots = getattr(cls, "__slots__", ())
+        assert (attr in slots or hasattr(cls, attr)
+                or f"self.{attr}" in src), (cls, attr)
+
+
+@pytest.mark.slow
+def test_race_detector_clean_on_threaded_runtime():
+    """The CI contract in miniature: a real (small) nemesis soak under
+    the detector reports zero witnesses with live instrumentation."""
+    from crdt_tpu.harness import nemesis_soak
+
+    installed = race.install()
+    assert installed > 0
+    try:
+        nemesis_soak.run_soak(seed=3, nodes=2, steps=40)
+        rpt = race.report()
+        assert rpt["witness_count"] == 0, "\n".join(rpt["witnesses"])
+        traffic = sum(c["reads"] + c["writes"]
+                      for c in rpt["access_counts"].values())
+        assert traffic > 0, "watchpoints saw no traffic"
+    finally:
+        race.uninstall()
