@@ -1,0 +1,239 @@
+"""A/B: what each consistency level costs, and what stability GC buys.
+
+Two phases, both over an in-process 3-replica fleet (peer objects call
+straight into sibling ``ReplicaNode``s — no sockets, so the numbers are
+the PROTOCOL cost of each guarantee: quorum rounds, dominance waits,
+catch-up pulls.  Wire latency multiplies the round count, it does not
+change it):
+
+* **read-levels** — the same key read N times at each level.
+  ``eventual`` is the local-read floor; ``session`` pays a vv dominance
+  check against an already-satisfied token (the steady-state fast path)
+  plus one measured cold arm where the token forces a proxy pull;
+  ``linearizable`` pays the full quorum round (vv collect + catch-up)
+  every read.  Reported per-arm p50/p99 come from the plane's own
+  ``strong_read_quorum_seconds`` histogram where it applies, wall
+  clocks elsewhere — the same series obs/health.py exports.
+
+* **gc-footprint** — one seeded write/gossip schedule driven twice:
+  arm A mints a StabilityTracker frontier every ``gc_every`` rounds and
+  compacts (the coordinated-GC path the nemesis --gc soak audits), arm
+  B never collects.  Both arms must end BIT-EQUAL in state and version
+  vector (transparency is asserted, not assumed); the payoff reported
+  is retained raw op rows and full-payload JSON bytes, A vs B.
+
+Methodology (house rules, benches/bench_baseline.py): medians over
+reps, JSON rows on stdout.
+
+Usage:
+  python benches/bench_consistency.py          # default shape
+  python benches/bench_consistency.py --tiny   # CI smoke
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import random
+import statistics
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+class _Peer:
+    """In-process RemotePeer stand-in over a sibling ReplicaNode."""
+
+    def __init__(self, node, url):
+        self.node, self.url = node, url
+
+    def backed_off(self):
+        return False
+
+    def version_vector(self):
+        return self.node.vv_snapshot()
+
+    def gossip_payload(self, since=None):
+        return self.node.gossip_payload(since=since)
+
+    def push_payload(self, payload):
+        self.node.receive(payload)
+        return True
+
+
+def _fleet(n=3, capacity=1024):
+    from crdt_tpu.api.node import ReplicaNode
+
+    nodes = [ReplicaNode(rid=i, capacity=capacity) for i in range(n)]
+    return nodes
+
+
+def _plane(nodes, i):
+    from crdt_tpu.consistency import ConsistencyPlane
+
+    peers = [_Peer(n, f"n{j}") for j, n in enumerate(nodes) if j != i]
+    return ConsistencyPlane(nodes[i], peers=lambda: peers)
+
+
+def _exchange(nodes):
+    for dst in nodes:
+        for src in nodes:
+            if src is not dst:
+                dst.receive(src.gossip_payload(since=dst.version_vector()))
+
+
+def _quantiles(samples):
+    s = sorted(samples)
+    return {"p50_us": round(1e6 * s[len(s) // 2], 1),
+            "p99_us": round(1e6 * s[min(len(s) - 1, int(len(s) * 0.99))], 1)}
+
+
+def bench_read_levels(n_reads: int, seed: int):
+    from crdt_tpu.consistency import mint_token
+
+    nodes = _fleet()
+    writer = nodes[0]  # the plane below serves from nodes[1]
+    idents = writer.add_commands(
+        [{f"k{i}": f"v{i}"} for i in range(64)])
+    _exchange(nodes)
+    plane = _plane(nodes, 1)
+    warm_token = mint_token(idents)
+    rng = random.Random(seed)
+    keys = [f"k{rng.randrange(64)}" for _ in range(n_reads)]
+
+    rows = []
+    for level, token in (("eventual", None),
+                         ("session", warm_token),
+                         ("linearizable", None)):
+        walls = []
+        for k in keys:
+            t0 = time.perf_counter()
+            plane.read(k, level=level, token=token)
+            walls.append(time.perf_counter() - t0)
+        rows.append({"phase": "read-levels", "level": level,
+                     "n_reads": n_reads, **_quantiles(walls)})
+
+    # cold session arm: every read's token names a write the serving
+    # node has NOT yet pulled — pays one proxy round before serving
+    walls = []
+    for i in range(min(n_reads, 64)):
+        ident = writer.add_commands([{f"cold{i}": "v"}])
+        token = mint_token(ident)
+        t0 = time.perf_counter()
+        plane.read(f"cold{i}", level="session", token=token)
+        walls.append(time.perf_counter() - t0)
+    rows.append({"phase": "read-levels", "level": "session-cold",
+                 "n_reads": len(walls), **_quantiles(walls)})
+    return rows
+
+
+def _drive(gc_every: int, rounds: int, ops_per_round: int, seed: int):
+    """One seeded write/gossip schedule; gc_every=0 disables collection."""
+    from crdt_tpu.consistency import StabilityTracker
+
+    nodes = _fleet(capacity=max(1024, 2 * rounds * ops_per_round * 3))
+    labels = [f"n{i}" for i in range(len(nodes))]
+    trackers = [
+        StabilityTracker(n, [m for j, m in enumerate(labels) if j != i],
+                         clock=time.monotonic)
+        for i, n in enumerate(nodes)
+    ]
+    rng = random.Random(seed)
+    for r in range(rounds):
+        for n in nodes:
+            n.add_commands([{f"k{rng.randrange(32)}": f"v{r}"}
+                            for _ in range(ops_per_round)])
+        _exchange(nodes)
+        for i, tr in enumerate(trackers):
+            for j, src in enumerate(nodes):
+                if j != i:
+                    vv, frontier = src.vv_snapshot()
+                    tr.note(labels[j], vv, frontier)
+        if gc_every and (r + 1) % gc_every == 0:
+            for n, tr in zip(nodes, trackers):
+                f = tr.mint(step=r)
+                if f:
+                    n.compact(f)
+    _exchange(nodes)
+    return nodes
+
+
+def bench_gc_footprint(rounds: int, ops_per_round: int, gc_every: int,
+                       seed: int):
+    gc_on = _drive(gc_every, rounds, ops_per_round, seed)
+    gc_off = _drive(0, rounds, ops_per_round, seed)
+
+    # transparency: coordinated collection must be invisible to readers
+    for a, b in zip(gc_on, gc_off):
+        assert a.get_state() == b.get_state(), "GC changed observable state"
+        assert a.version_vector() == b.version_vector(), "GC changed vv"
+
+    def footprint(nodes):
+        raw = sum(len(n._commands) for n in nodes)
+        payload = sum(len(json.dumps(n.gossip_payload())) for n in nodes)
+        return raw, payload
+
+    raw_on, bytes_on = footprint(gc_on)
+    raw_off, bytes_off = footprint(gc_off)
+    reclaimed = sum(
+        int(n.metrics.registry.counter_value("gc_reclaimed_ops"))
+        for n in gc_on)
+    return [{
+        "phase": "gc-footprint", "rounds": rounds,
+        "ops_per_round": ops_per_round, "gc_every": gc_every,
+        "raw_rows_gc_on": raw_on, "raw_rows_gc_off": raw_off,
+        "payload_bytes_gc_on": bytes_on, "payload_bytes_gc_off": bytes_off,
+        "reclaimed_ops": reclaimed,
+        "bit_equal": True,
+    }]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--n-reads", type=int, default=512)
+    ap.add_argument("--rounds", type=int, default=24)
+    ap.add_argument("--ops-per-round", type=int, default=32)
+    ap.add_argument("--gc-every", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="read-level reps; medians of p50s are reported")
+    ap.add_argument("--tiny", action="store_true",
+                    help="CI smoke: 64 reads, 8 rounds, 1 rep")
+    args = ap.parse_args()
+    if args.tiny:
+        args.n_reads, args.rounds, args.reps = 64, 8, 1
+        args.ops_per_round = 16
+
+    # rep 0 absorbs jit warm-up for the shapes in play
+    all_rows = []
+    per_level = {}
+    for rep in range(args.reps + 1):
+        rows = bench_read_levels(args.n_reads, args.seed + rep)
+        if rep == 0:
+            continue
+        for r in rows:
+            per_level.setdefault(r["level"], []).append(r)
+    for level, rows in per_level.items():
+        all_rows.append({
+            "phase": "read-levels", "level": level,
+            "n_reads": rows[0]["n_reads"], "reps": len(rows),
+            "p50_us": round(statistics.median(r["p50_us"] for r in rows), 1),
+            "p99_us": round(statistics.median(r["p99_us"] for r in rows), 1),
+        })
+
+    all_rows += bench_gc_footprint(args.rounds, args.ops_per_round,
+                                   args.gc_every, args.seed)
+    for row in all_rows:
+        print(json.dumps(row, sort_keys=True))
+
+    gc_row = all_rows[-1]
+    if gc_row["raw_rows_gc_on"] >= gc_row["raw_rows_gc_off"]:
+        print("FAIL: GC did not shrink the raw op-log footprint",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,29 @@ compaction barrier, crdt_tpu.api.net.network_compact):
   GET  /vv                      {"vv": {rid: seq}, "frontier": {rid: seq}}
   POST /compact                 {"frontier": {rid: seq}} -> fold + prune
 
+Consistency plane (crdt_tpu.consistency; /read and /cas present only with
+``admin`` — they need the NodeHost's ConsistencyPlane):
+  GET  /read?key=k&level=l      l in eventual|session|linearizable; a
+                                session read requires the caller's token
+                                in the X-CRDT-Session-Token request
+                                header.  200 {"key","value","level"};
+                                503 {"error":"consistency_unavailable",...}
+                                when the level's guarantee cannot be met
+                                (never a silently stale value)
+  POST /cas                     {"key","expect","update"} (expect null =
+                                key must be absent) -> 200 {"token"},
+                                409 {"conflict":true,"actual"},
+                                503 as /read ("indeterminate":true once
+                                the write was minted but not quorum-acked)
+  POST /push                    {"payload": <gossip payload>} -> merge NOW
+                                ("fresh": n): the synchronous write-quorum
+                                leg of CAS
+  POST /data additionally answers with an X-CRDT-Session-Token response
+  header (the write's vv watermark, minted from the ingest ticket ident)
+  when the node has an ingest front door; every GET /gossip response
+  carries an X-CRDT-Stability header ({rid, vv, frontier}) — the
+  piggyback that feeds the StabilityTracker with zero extra round trips.
+
 Observability (crdt_tpu.obs):
   GET  /metrics                 Prometheus text exposition (counters,
                                 gauges, latency histograms + the lattice
@@ -26,6 +49,8 @@ Daemon admin extensions (present only when the handler is built with an
 fleet deterministically, crdt_tpu.harness.crashsoak):
   POST /admin/pull              {"peer": url?} -> one gossip pull now
   POST /admin/barrier           one compaction barrier now (coordinator)
+  POST /admin/stability_gc      one stability-frontier GC round now
+                                (coordinator; zero-round-trip barrier)
   POST /admin/checkpoint        crash-safe snapshot now
   POST /admin/set_pull          {"peer": url?} -> one set pull now
   POST /admin/set_barrier       one set GC barrier now (coordinator)
@@ -84,6 +109,13 @@ from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from crdt_tpu.api.cluster import LocalCluster
+from crdt_tpu.consistency.plane import CasConflict, ConsistencyUnavailable
+from crdt_tpu.consistency.session import (
+    SESSION_TOKEN_HEADER,
+    decode_token,
+    encode_token,
+)
+from crdt_tpu.consistency.stability import STABILITY_HEADER, encode_summary
 from crdt_tpu.ingest import PageFormatError, ShedError
 from crdt_tpu.obs import health
 from crdt_tpu.obs.trace import TRACE_HEADER
@@ -152,6 +184,30 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 return getattr(admin, "ingest", None)
             doors = getattr(cluster, "ingests", None)
             return doors[idx] if doors else None
+
+        @property
+        def consistency(self):
+            """The node's ConsistencyPlane (crdt_tpu.consistency), or
+            None — /read and /cas 404 without one (a bare LocalCluster
+            has no RemotePeers to run quorum rounds over)."""
+            return getattr(admin, "consistency", None) \
+                if admin is not None else None
+
+        def _send_unavailable(self, exc: ConsistencyUnavailable):
+            """503 Service Unavailable: the loud face of a strong
+            operation that cannot meet its guarantee — never a silently
+            stale value (paired 1:1 with a consistency_unavailable
+            event by the plane)."""
+            self._send_bytes(
+                503,
+                json.dumps({
+                    "error": "consistency_unavailable",
+                    "reason": exc.reason, "level": exc.level,
+                    "op": exc.op, "acks": exc.acks, "quorum": exc.quorum,
+                    "indeterminate": exc.indeterminate,
+                }).encode(),
+                "application/json",
+            )
 
         def _send_shed(self, exc: ShedError):
             """429 Too Many Requests + Retry-After: the loud, explicit
@@ -311,6 +367,8 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     composite_node=self.composite_node,
                     agent=getattr(admin, "agent", None),
                     ingest=self.ingest,
+                    stability=getattr(getattr(admin, "agent", None),
+                                      "stability", None),
                 )
                 self._send(200, body, PROM_CTYPE)
             elif url.path == "/ping":
@@ -354,10 +412,43 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         peer=self.client_address[0], delta=since is not None,
                         bytes=len(body),
                     )
-                self._send_bytes(
-                    200, body, "application/json",
-                    extra_headers={TRACE_HEADER: trace} if trace else None,
-                )
+                # every gossip response piggybacks this node's stability
+                # summary — the zero-round-trip feed of the fleet-wide
+                # stable frontier (crdt_tpu.consistency.stability)
+                vv, frontier = self.node.vv_snapshot()
+                extra = {STABILITY_HEADER:
+                         encode_summary(self.node.rid, vv, frontier)}
+                if trace:
+                    extra[TRACE_HEADER] = trace
+                self._send_bytes(200, body, "application/json",
+                                 extra_headers=extra)
+            elif url.path == "/read":
+                plane = self.consistency
+                if plane is None:
+                    self._send(404, "no consistency plane on this node")
+                    return
+                q = parse_qs(url.query)
+                key = q.get("key", [None])[0]
+                if key is None:
+                    self._send(400, "missing key")
+                    return
+                level = q.get("level", ["eventual"])[0]
+                token = decode_token(self.headers.get(SESSION_TOKEN_HEADER))
+                if level == "session" and token is None:
+                    self._send(400, "session read requires a valid "
+                                    f"{SESSION_TOKEN_HEADER} header")
+                    return
+                try:
+                    value = plane.read(key, level=level, token=token)
+                except ValueError as e:
+                    self._send(400, str(e))
+                    return
+                except ConsistencyUnavailable as e:
+                    self._send_unavailable(e)
+                    return
+                self._send(200, json.dumps(
+                    {"key": key, "value": value, "level": level}
+                ), "application/json")
             elif url.path == "/vv":
                 if not self.node.alive:
                     self._send(502, "Unreachable")
@@ -422,6 +513,16 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                                    "application/json")
                     elif path == "/admin/barrier":
                         frontier = admin.admin_barrier()
+                        self._send(
+                            200,
+                            json.dumps({
+                                "frontier": {str(r): s
+                                             for r, s in frontier.items()}
+                            }),
+                            "application/json",
+                        )
+                    elif path == "/admin/stability_gc":
+                        frontier = admin.admin_stability_gc()
                         self._send(
                             200,
                             json.dumps({
@@ -720,6 +821,73 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 self.node.compact(frontier)
                 self._send(200, "OK")
                 return
+            if path == "/push":
+                # the synchronous write-quorum leg of CAS (crdt_tpu
+                # .consistency.plane): merge the pushed payload BEFORE
+                # answering, so a 200 proves this node's vv dominates
+                # every op it carried
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    payload = body.get("payload")
+                    assert isinstance(payload, dict)
+                except Exception:
+                    self._send(400, "invalid payload")
+                    return
+                if not self.node.alive:
+                    self._send(502, "Unreachable")
+                    return
+                try:
+                    fresh = self.node.receive(payload)
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, f"malformed payload: "
+                                    f"{type(e).__name__}: {e}")
+                    return
+                self._send(200, json.dumps({"fresh": fresh}),
+                           "application/json")
+                return
+            if path == "/cas":
+                plane = self.consistency
+                if plane is None:
+                    self._send(404, "no consistency plane on this node")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    assert isinstance(body, dict)
+                    key = str(body["key"])
+                    expect = body.get("expect")
+                    expect = None if expect is None else str(expect)
+                    update = str(body["update"])
+                except Exception:
+                    self._send(400, "invalid body: need key/update "
+                                    "(expect null = key must be absent)")
+                    return
+                try:
+                    token = plane.cas(key, expect, update)
+                except CasConflict as e:
+                    self._send_bytes(
+                        409,
+                        json.dumps({
+                            "conflict": True, "key": e.key,
+                            "expect": e.expect, "actual": e.actual,
+                        }).encode(),
+                        "application/json",
+                    )
+                    return
+                except ConsistencyUnavailable as e:
+                    self._send_unavailable(e)
+                    return
+                self._send_bytes(
+                    200,
+                    json.dumps({"token": {str(r): s
+                                          for r, s in token.items()}}
+                               ).encode(),
+                    "application/json",
+                    extra_headers={
+                        SESSION_TOKEN_HEADER: encode_token(token)},
+                )
+                return
             if path != "/data":
                 self._send(404, "not found")
                 return
@@ -742,7 +910,15 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     self._send_shed(e)
                     return
                 if ident is not None:
-                    self._send(200, "Inserted")
+                    # the ticket ident IS the session token: the vv
+                    # watermark a session read must dominate to see this
+                    # write.  Rides a response header so the body stays
+                    # byte-compatible with the Go surface ("Inserted").
+                    self._send_bytes(
+                        200, b"Inserted", "text/plain",
+                        extra_headers={SESSION_TOKEN_HEADER: encode_token(
+                            {ident[0]: ident[1]})},
+                    )
                 else:
                     self._send(502, "Unreachable")
                 return

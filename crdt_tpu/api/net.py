@@ -39,6 +39,12 @@ from crdt_tpu.api.node import (
     pull_round,
     stable_frontier_host,
 )
+from crdt_tpu.consistency.plane import ConsistencyPlane
+from crdt_tpu.consistency.stability import (
+    STABILITY_HEADER,
+    StabilityTracker,
+    decode_summary,
+)
 from crdt_tpu.obs.events import EventLog
 from crdt_tpu.obs.trace import TRACE_HEADER, mint_trace_id
 from crdt_tpu.utils.config import ClusterConfig
@@ -100,6 +106,12 @@ class RemotePeer:
         # threads AND read by the agent loop — a torn failures/retry_at
         # pair would mint a bogus backoff window (crdtlint CRDT201)
         self._backoff_lock = threading.Lock()
+        # last X-CRDT-Stability response header captured by _get (raw
+        # string; decoded lazily by take_stability).  Captured in the BASE
+        # transport so the nemesis FaultyTransport — which defers here —
+        # subjects summaries to the same drop/delay schedule as bodies.
+        self._stability_lock = threading.Lock()
+        self._stability_raw: Optional[str] = None
 
     def _note_reachable(self) -> None:
         with self._backoff_lock:
@@ -148,12 +160,26 @@ class RemotePeer:
         with self._backoff_lock:
             return self.failures
 
+    def take_stability(self) -> Optional[Dict[str, Any]]:
+        """Pop the last captured stability summary ({rid, vv, frontier}
+        with int keys), or None when no response since the previous take
+        carried one.  Pop semantics keep a redelivered/stalled round from
+        double-counting an old capture; garbage headers decode to None
+        (same skip posture as _parse)."""
+        with self._stability_lock:
+            raw, self._stability_raw = self._stability_raw, None
+        return decode_summary(raw)
+
     def _get(self, path: str,
              headers: Optional[Dict[str, str]] = None) -> Optional[bytes]:
         req = urllib.request.Request(self.url + path, headers=headers or {})
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as res:
                 body = res.read() if res.status == 200 else None
+                stab = res.headers.get(STABILITY_HEADER)
+                if stab is not None:
+                    with self._stability_lock:
+                        self._stability_raw = stab
         except urllib.error.HTTPError:
             self._note_reachable()  # served an error status: peer is UP
             return None
@@ -287,6 +313,15 @@ class RemotePeer:
             "/compact",
             {"frontier": {str(r): s for r, s in frontier.items()}},
         )
+
+    def push_payload(self, payload: Dict[str, Any]) -> bool:
+        """POST /push: hand the peer a gossip payload to merge NOW —
+        the synchronous write-quorum leg of CAS (crdt_tpu.consistency
+        .plane).  A 200 means the peer merged it before answering, so
+        its vv dominates every op the payload carried; built on _post,
+        so it crosses the nemesis fault plane and the circuit breaker
+        like every other leg."""
+        return self._post("/push", {"payload": payload})
 
     # ---- extension-surface probe (shared by /set and /seq clients) ----
 
@@ -502,6 +537,15 @@ class NetworkAgent:
         # compaction-barrier scheduler: exactly ONE agent in the fleet may
         # coordinate (see network_compact's single-scheduler rule)
         self.coordinator = coordinator
+        # stability bookkeeping (crdt_tpu.consistency.stability): fed from
+        # the X-CRDT-Stability headers captured by the pull paths; only
+        # the coordinator mints/pushes frontiers, but every node tracks —
+        # the lag gauges are fleet-wide facts
+        self.stability = StabilityTracker(
+            node, [p.url for p in self.peers],
+            max_staleness=self.config.stability_max_staleness_s,
+            events=node.events,
+        )
         self._rng = random.Random(self.config.seed if seed is None else seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -555,7 +599,7 @@ class NetworkAgent:
             with self.metrics.timer("net_fetch"):
                 return peer.gossip_payload(since, trace=tid)
 
-        return pull_round(
+        merged = pull_round(
             self.node,
             fetch,
             self.metrics,
@@ -565,6 +609,18 @@ class NetworkAgent:
             trace=tid,
             quarantine=True,
         )
+        self._note_stability(peer)
+        return merged
+
+    def _note_stability(self, peer: RemotePeer) -> None:
+        """Feed the tracker any stability summary the round's responses
+        piggybacked (no summary = no-op; the tracker's staleness rule
+        handles silent peers).  Duck-typed: test doubles and minimal peer
+        shims that don't capture headers simply never feed the tracker."""
+        take = getattr(peer, "take_stability", None)
+        s = take() if take is not None else None
+        if s is not None:
+            self.stability.note(peer.url, s["vv"], s["frontier"])
 
     def _available_peers(self) -> List[RemotePeer]:
         """Peers not inside a transport-failure backoff window.  Skips are
@@ -613,6 +669,10 @@ class NetworkAgent:
             quarantine=True,
         )
         responding = [p for p, body in zip(peers, payloads) if body is not None]
+        for peer in peers:
+            # fused rounds feed the tracker too — the headers rode the
+            # same concurrent fetches (no extra round trips)
+            self._note_stability(peer)
         for peer in responding:
             # unreachable-this-round peers are skipped: don't re-pay the
             # timeout.  The set/seq/map hosts are pure-dict joins with no
@@ -708,6 +768,39 @@ class NetworkAgent:
         frontier = network_compact(self.node, self.peers)
         self.metrics.inc(
             "net_compactions" if frontier else "net_compact_skipped"
+        )
+        return frontier
+
+    def stability_gc_once(self, step: Optional[int] = None) -> dict:
+        """One fleet-coordinated GC round from the piggybacked stability
+        frontier (coordinator only — the single-scheduler rule of
+        network_compact applies unchanged).
+
+        Unlike compact_once this costs NO vv-collection round trips: the
+        frontier is minted from summaries that rode earlier gossip
+        responses.  A stalled tracker (missing/stale member) skips the
+        round loudly ({} + stability_stalled already emitted by the
+        tracker); a successful mint folds locally then pushes POST
+        /compact to every peer SEQUENTIALLY in peer-list order — the
+        deterministic-replay rule of the nemesis plane — and a peer that
+        misses the POST self-heals by adopting the frontier from any
+        folded peer's gossip payload (_adopt_frontier_locked)."""
+        if not self.node.alive:
+            self.metrics.inc("stability_gc_skipped")
+            return {}
+        frontier = self.stability.mint(step=step)
+        if not frontier:
+            self.metrics.inc("stability_gc_skipped")
+            return {}
+        self.node.compact(frontier)
+        for p in self.peers:
+            if not p.backed_off():
+                p.compact(frontier)
+        self.metrics.inc("stability_gc_rounds")
+        self.node.events.emit(
+            "stability_gc",
+            frontier={str(r): s for r, s in frontier.items()},
+            members=len(self.peers) + 1,
         )
         return frontier
 
@@ -911,6 +1004,9 @@ class NetworkAgent:
                 mre = self.config.map_reset_every
                 if self.coordinator and mre and rounds % mre == 0:
                     self.map_reset_once()
+                sge = self.config.stability_gc_every
+                if self.coordinator and sge and rounds % sge == 0:
+                    self.stability_gc_once()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.metrics.inc("net_gossip_loop_errors")
                 with self._err_lock:
@@ -1031,6 +1127,17 @@ class NodeHost:
             set_node=self.set_node, seq_node=self.seq_node,
             map_node=self.map_node, composite_node=self.composite_node,
         )
+        # strong read/CAS coordinator (crdt_tpu.consistency): reads
+        # agent.peers LIVE so a harness that swaps the peer list for
+        # FaultyTransports after boot keeps the plane inside the fault
+        # schedule
+        self.consistency = ConsistencyPlane(
+            self.node, agent=self.agent,
+            quorum=self.config.strong_quorum,
+            strong_timeout=self.config.strong_timeout_s,
+            session_timeout=self.config.session_wait_s,
+            poll=self.config.session_poll_s,
+        )
         self._server = ThreadingHTTPServer(
             (host, port), _make_handler(self, 0, admin=self)
         )
@@ -1138,6 +1245,12 @@ class NodeHost:
         """One compaction barrier, now (this host must be the fleet's
         single coordinator)."""
         return self.agent.compact_once()
+
+    def admin_stability_gc(self) -> dict:
+        """One stability-frontier GC round, now (coordinator only): mint
+        the fleet frontier from piggybacked summaries and fold it
+        everywhere — the zero-round-trip alternative to admin_barrier."""
+        return self.agent.stability_gc_once()
 
     def admin_set_pull(self, peer_url: Optional[str] = None) -> bool:
         """One set-lattice pull, now, from ``peer_url`` (or a random

@@ -845,6 +845,11 @@ class ReplicaNode:
             epoch = self.clock.epoch_ms
             for k in self._commands.keys() - kept.keys():
                 self._wire.remove(k[0] + epoch, k[1], k[2])
+        reclaimed = len(self._commands) - len(kept)
+        if reclaimed:
+            # ops actually freed by this fold/adoption — the GC payoff
+            # counter behind crdt_gc_reclaimed_ops_total (obs/health.py)
+            self.metrics.inc("gc_reclaimed_ops", reclaimed)
         self._commands = kept
         for w, lst in self._by_writer.items():
             cut = f.get(w, -1)

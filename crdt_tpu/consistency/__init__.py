@@ -1,0 +1,41 @@
+"""Consistency plane: session guarantees, quorum strong reads/CAS, and
+stability-frontier coordinated GC — see crdt_tpu/consistency/README.md."""
+from crdt_tpu.consistency.plane import (
+    LEVELS,
+    CasConflict,
+    ConsistencyPlane,
+    ConsistencyUnavailable,
+)
+from crdt_tpu.consistency.session import (
+    SESSION_TOKEN_HEADER,
+    decode_token,
+    encode_token,
+    mint_token,
+    token_join,
+    vv_dominates,
+    wait_for_dominance,
+)
+from crdt_tpu.consistency.stability import (
+    STABILITY_HEADER,
+    StabilityTracker,
+    decode_summary,
+    encode_summary,
+)
+
+__all__ = [
+    "LEVELS",
+    "CasConflict",
+    "ConsistencyPlane",
+    "ConsistencyUnavailable",
+    "SESSION_TOKEN_HEADER",
+    "STABILITY_HEADER",
+    "StabilityTracker",
+    "decode_summary",
+    "decode_token",
+    "encode_summary",
+    "encode_token",
+    "mint_token",
+    "token_join",
+    "vv_dominates",
+    "wait_for_dominance",
+]

@@ -1,0 +1,316 @@
+"""Tunable consistency: eventual | session | linearizable reads, plus CAS.
+
+"Linearizable State Machine Replication of State-Based CRDTs without
+Logs" (PAPERS.md) layers strong operations on an unmodified lattice by
+using the version-vector frontier as the progress measure: a read is
+linearizable once the serving replica provably dominates a quorum's
+watermarks at some point after the request began.  This module is that
+layer for the KV surface:
+
+* ``eventual``      — the plain local read (unchanged fast path);
+* ``session``       — local read gated on dominance of the caller's
+                      session token ([[session]]): read-your-writes and
+                      monotonic reads, waiting-or-proxying until the
+                      local vv catches up;
+* ``linearizable``  — a quorum round over RemotePeers: collect vv
+                      watermarks from a majority (breaker-aware — an OPEN
+                      circuit counts as a missing ack instead of a paid
+                      timeout), pull until the local vv dominates their
+                      pointwise max, then serve locally;
+* ``cas``           — linearizable read + expected-value check + local
+                      mint + synchronous delta push to a write quorum.
+
+Failure posture: strong operations NEVER silently degrade.  Quorum loss,
+catch-up timeout, or a dead local node raise ``ConsistencyUnavailable``
+(HTTP 503) and emit a ``consistency_unavailable`` event — the nemesis
+--strong oracle audits the 1:1 correspondence and that no stale value is
+ever served in place of an error.  A CAS that minted its write but could
+not reach a write quorum raises with ``indeterminate=True``: the op
+exists and will propagate via anti-entropy; the caller must treat the
+outcome as unknown (retry with the ACTUAL value it reads next).
+
+Concurrency note: CAS serializes through one plane-wide lock, so
+conflicting CAS operations are decided locally only when routed to the
+SAME node.  Cross-node CAS on one key needs same-node routing (the
+single-coordinator idiom the barrier paths already use) — see
+consistency/README.md's failure-mode table.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from crdt_tpu.consistency.session import (
+    mint_token,
+    vv_dominates,
+    wait_for_dominance,
+)
+
+LEVELS = ("eventual", "session", "linearizable")
+
+
+class ConsistencyUnavailable(Exception):
+    """Strong guarantee cannot be met right now — HTTP 503, never a
+    silently stale value.  ``indeterminate`` marks a CAS whose write was
+    minted locally but not quorum-acked (outcome unknown to the caller)."""
+
+    def __init__(self, reason: str, *, level: str = "linearizable",
+                 op: str = "read", acks: int = 0, quorum: int = 0,
+                 indeterminate: bool = False):
+        self.reason = reason
+        self.level = level
+        self.op = op
+        self.acks = acks
+        self.quorum = quorum
+        self.indeterminate = indeterminate
+        super().__init__(
+            f"{level} {op} unavailable: {reason} "
+            f"(acks={acks} quorum={quorum})"
+        )
+
+
+class CasConflict(Exception):
+    """CAS expectation failed — HTTP 409 carrying the actual value so the
+    caller can re-derive and retry."""
+
+    def __init__(self, key: str, expect: Optional[str],
+                 actual: Optional[str]):
+        self.key = key
+        self.expect = expect
+        self.actual = actual
+        super().__init__(f"cas conflict on {key!r}: "
+                         f"expected {expect!r}, found {actual!r}")
+
+
+class ConsistencyPlane:
+    """Per-node strong-read/CAS coordinator over the agent's RemotePeers.
+
+    ``peers`` defaults to reading ``agent.peers`` live (the nemesis swaps
+    that list for FaultyTransports after boot; reading it per-operation
+    keeps the plane inside the fault schedule).  ``clock``/``sleep`` are
+    injectable so tests drive the wait loops on a fake clock."""
+
+    def __init__(self, node, *, agent=None,
+                 peers: Optional[Callable[[], List]] = None,
+                 quorum: int = 0, strong_timeout: float = 5.0,
+                 session_timeout: float = 5.0, poll: float = 0.02,
+                 events=None, metrics=None,
+                 clock: Optional[Callable[[], float]] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
+        self.node = node
+        self.agent = agent
+        self._peers_fn = peers
+        self.quorum = int(quorum)  # 0 = majority of (peers + self)
+        self.strong_timeout = float(strong_timeout)
+        self.session_timeout = float(session_timeout)
+        self.poll = float(poll)
+        self.events = events if events is not None else node.events
+        self.metrics = metrics if metrics is not None else node.metrics
+        self.clock = clock or time.monotonic
+        self.sleep = sleep or time.sleep
+        self._cas_lock = threading.Lock()
+
+    # ---- membership ----
+
+    def _peers(self) -> List:
+        if self._peers_fn is not None:
+            return list(self._peers_fn())
+        if self.agent is not None:
+            return list(self.agent.peers)
+        return []
+
+    def _quorum_of(self, n_members: int) -> int:
+        return self.quorum if self.quorum > 0 else n_members // 2 + 1
+
+    # ---- failure bookkeeping ----
+
+    def _unavailable(self, reason: str, *, level: str, op: str,
+                     acks: int = 0, quorum: int = 0,
+                     indeterminate: bool = False) -> ConsistencyUnavailable:
+        self.metrics.inc("consistency_unavailable")
+        self.events.emit("consistency_unavailable", reason=reason,
+                         level=level, op=op, acks=acks, quorum=quorum,
+                         indeterminate=indeterminate)
+        return ConsistencyUnavailable(
+            reason, level=level, op=op, acks=acks, quorum=quorum,
+            indeterminate=indeterminate)
+
+    # ---- proxy pulls (shared by session waits and quorum catch-up) ----
+
+    def _guarded_receive(self, payload, peer: Optional[str] = None) -> None:
+        """Merge a proxied payload; malformed content is skipped (the
+        quarantine posture of the pull loop), never fatal to the wait —
+        and logged as the same ``payload_quarantine`` event the pull loop
+        emits, so corruption accounting stays 1:1 whichever path fetched
+        the payload (the nemesis --strong oracle audits this)."""
+        try:
+            self.node.receive(payload)
+        except (ValueError, KeyError, TypeError) as e:
+            self.metrics.inc("consistency_proxy_quarantine")
+            self.events.emit("payload_quarantine", peer=peer,
+                             surface="consistency_proxy",
+                             error=f"{type(e).__name__}: {e}"[:200])
+
+    def _proxy_pull(self, peers: Optional[List] = None) -> None:
+        """One proxy round: fetch each responsive peer's delta since our
+        vv and merge it — fills session/quorum gaps without waiting for
+        the background gossip cadence."""
+        vv, _ = self.node.vv_snapshot()
+        for p in (self._peers() if peers is None else peers):
+            if p.backed_off():
+                continue
+            payload = p.gossip_payload(since=vv)
+            if payload:
+                self._guarded_receive(payload, peer=p.url)
+
+    # ---- quorum machinery ----
+
+    def _collect_quorum(self, *, level: str, op: str) -> List[Tuple]:
+        """Collect (peer, vv) watermarks from enough members to prove a
+        quorum view.  Sequential, in peer-list order — deterministic under
+        the nemesis schedule — with OPEN breakers skipped (a partitioned
+        peer costs a missing ack, not a paid timeout: the PR 4 liveness
+        lever).  Raises ConsistencyUnavailable when acks < quorum."""
+        peers = self._peers()
+        q = self._quorum_of(len(peers) + 1)
+        if not self.node.alive:
+            raise self._unavailable("node_down", level=level, op=op,
+                                    quorum=q)
+        responding: List[Tuple] = []
+        for p in peers:
+            if p.backed_off():
+                continue
+            got = p.version_vector()
+            if got is None:
+                continue
+            responding.append((p, got[0]))
+        acks = 1 + len(responding)  # self always acks while alive
+        if acks < q:
+            raise self._unavailable("quorum_lost", level=level, op=op,
+                                    acks=acks, quorum=q)
+        return responding
+
+    def _catch_up(self, responding: List[Tuple], deadline: float, *,
+                  level: str, op: str) -> None:
+        """Pull from the quorum until the local vv dominates the pointwise
+        max of every collected watermark (the linearization point: we now
+        hold everything any quorum member had acknowledged)."""
+        target: Dict[int, int] = {}
+        for _, vv in responding:
+            for r, s in vv.items():
+                if s > target.get(r, -1):
+                    target[r] = s
+        while True:
+            vv, _ = self.node.vv_snapshot()
+            if vv_dominates(vv, target):
+                return
+            if self.clock() >= deadline:
+                q = self._quorum_of(len(self._peers()) + 1)
+                raise self._unavailable("catchup_timeout", level=level,
+                                        op=op, acks=1 + len(responding),
+                                        quorum=q)
+            self._proxy_pull([p for p, _ in responding])
+            vv, _ = self.node.vv_snapshot()
+            if vv_dominates(vv, target):
+                return
+            self.sleep(self.poll)
+
+    # ---- public API ----
+
+    def read(self, key: str, level: str = "eventual",
+             token: Optional[Dict[int, int]] = None,
+             timeout: Optional[float] = None) -> Optional[str]:
+        """Read ``key`` at the requested consistency level.  Returns the
+        value (None = key absent — a valid answer); raises
+        ConsistencyUnavailable when the level's guarantee cannot be met
+        and ValueError on caller mistakes (bad level, session without a
+        token)."""
+        if level not in LEVELS:
+            raise ValueError(f"unknown consistency level {level!r} "
+                             f"(one of {LEVELS})")
+        if level == "eventual":
+            state = self.node.get_state()
+            if state is None:
+                raise self._unavailable("node_down", level=level, op="read")
+            self.metrics.inc("reads_eventual")
+            return state.get(key)
+        if level == "session":
+            if token is None:
+                raise ValueError("session read requires a session token")
+            ok = wait_for_dominance(
+                self.node, token,
+                timeout=self.session_timeout if timeout is None else timeout,
+                poll=self.poll, clock=self.clock, sleep=self.sleep,
+                proxy=self._proxy_pull)
+            if not ok:
+                raise self._unavailable("token_timeout", level=level,
+                                        op="read")
+            state = self.node.get_state()
+            if state is None:
+                raise self._unavailable("node_down", level=level, op="read")
+            self.metrics.inc("reads_session")
+            return state.get(key)
+        # linearizable
+        t0 = self.clock()
+        deadline = t0 + (self.strong_timeout if timeout is None else timeout)
+        responding = self._collect_quorum(level=level, op="read")
+        self._catch_up(responding, deadline, level=level, op="read")
+        state = self.node.get_state()
+        if state is None:
+            raise self._unavailable("node_down", level=level, op="read")
+        self.metrics.observe("strong_read_quorum_seconds",
+                             self.clock() - t0)
+        self.metrics.inc("reads_linearizable")
+        return state.get(key)
+
+    def cas(self, key: str, expect: Optional[str], update: str,
+            timeout: Optional[float] = None) -> Dict[int, int]:
+        """Compare-and-set: atomically replace ``key``'s value with
+        ``update`` iff its linearizable-read value equals ``expect``
+        (``expect=None`` = key must be absent).  Returns the session
+        token covering the write (the caller's read-your-writes handle).
+
+        Raises CasConflict (409) on expectation failure and
+        ConsistencyUnavailable (503) on quorum loss — with
+        ``indeterminate=True`` when the write was already minted locally
+        but fewer than a quorum acked the synchronous push (the op WILL
+        still propagate via anti-entropy)."""
+        t0 = self.clock()
+        deadline = t0 + (self.strong_timeout if timeout is None else timeout)
+        with self._cas_lock:
+            responding = self._collect_quorum(level="linearizable", op="cas")
+            self._catch_up(responding, deadline, level="linearizable",
+                           op="cas")
+            state = self.node.get_state()
+            if state is None:
+                raise self._unavailable("node_down", level="linearizable",
+                                        op="cas")
+            actual = state.get(key)
+            if actual != expect:
+                self.metrics.inc("cas_conflicts")
+                raise CasConflict(key, expect, actual)
+            idents = self.node.add_commands([{key: update}])
+            if idents is None:
+                raise self._unavailable("node_down", level="linearizable",
+                                        op="cas")
+            token = mint_token(idents)
+            # synchronous write quorum: push the delta each reader is
+            # missing; a 200 means the peer merged it before answering
+            # (http_shim /push), so its vv now dominates the token
+            q = self._quorum_of(len(self._peers()) + 1)
+            acks = 1  # self
+            for p, peer_vv in responding:
+                if acks >= q:
+                    break
+                payload = self.node.gossip_payload(since=peer_vv)
+                if payload and p.push_payload(payload):
+                    acks += 1
+            if acks < q:
+                raise self._unavailable(
+                    "write_quorum_lost", level="linearizable", op="cas",
+                    acks=acks, quorum=q, indeterminate=True)
+            self.metrics.observe("strong_read_quorum_seconds",
+                                 self.clock() - t0)
+            self.metrics.inc("cas_applied")
+            return token

@@ -1,0 +1,193 @@
+"""Stability frontier: gossip-piggybacked vv summaries -> coordinated GC.
+
+An op ``(rid, seq)`` is STABLE once every fleet member's version vector
+dominates it — from then on no delta payload can ever need it again, so
+op-log rows, tombstones and wire commands under the stable frontier are
+garbage.  This module computes that frontier from summaries piggybacked on
+traffic the fleet already exchanges (zero new round trips):
+
+* every GET /gossip response carries an ``X-CRDT-Stability`` header with
+  the serving node's ``{rid, vv, frontier}`` snapshot (http_shim);
+* the base RemotePeer transport captures the header on ANY response that
+  carries it (so fused pull rounds feed the tracker for free, and the
+  nemesis FaultyTransport — which defers to ``super()._get`` — faults it
+  with the same schedule as the body);
+* the NetworkAgent hands captured summaries to its ``StabilityTracker``
+  after each pull round.
+
+The tracker's frontier rule is deliberately pessimistic ("Certified
+Mergeable Replicated Data Types" frames the invariant; the nemesis --gc
+oracle audits it 1:1):
+
+* a member with NO summary, or one older than ``max_staleness`` on the
+  tracker clock, STALLS the frontier: ``frontier()`` returns ``{}`` and
+  emits a ``stability_stalled`` event naming the laggards — a partitioned
+  or dead peer freezes GC loudly rather than letting the frontier advance
+  past ops it might still be missing;
+* a stale-but-real summary is always SAFE: vvs are monotone, so a
+  frontier minted from old watermarks is <= the true stable frontier —
+  staleness can only under-collect, never over-collect;
+* the candidate must satisfy the chain rule against every member's folded
+  frontier (``stable_frontier_host``): minted frontiers totally order, so
+  adoption via gossip (ReplicaNode._adopt_frontier_locked) never sees
+  incomparable folds.
+
+Every minted frontier is appended to ``ledger`` together with the exact
+summaries it was computed from — the audit trail the nemesis --gc safety
+oracle replays ("no op at-or-above the frontier is ever collected").
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# response header carrying the serving node's stability summary
+# (style of api.http_shim.TRACE_HEADER)
+STABILITY_HEADER = "X-CRDT-Stability"
+
+
+def encode_summary(rid: int, vv: Dict[int, int],
+                   frontier: Dict[int, int]) -> str:
+    """Header value for one node's summary (JSON keeps keys as strings,
+    same wire convention as the /vv body)."""
+    return json.dumps({
+        "rid": int(rid),
+        "vv": {str(r): int(s) for r, s in vv.items()},
+        "frontier": {str(r): int(s) for r, s in frontier.items()},
+    }, separators=(",", ":"))
+
+
+def decode_summary(raw: Optional[str]) -> Optional[Dict[str, Any]]:
+    """Parse a header value; garbage (truncated/corrupt header) decodes to
+    None and the round simply contributes no summary — same skip-don't-die
+    posture as RemotePeer._parse."""
+    if not raw:
+        return None
+    try:
+        d = json.loads(raw)
+        return {
+            "rid": int(d["rid"]),
+            "vv": {int(r): int(s) for r, s in (d.get("vv") or {}).items()},
+            "frontier": {int(r): int(s)
+                         for r, s in (d.get("frontier") or {}).items()},
+        }
+    except (ValueError, TypeError, KeyError):
+        return None
+
+
+class StabilityTracker:
+    """Fleet-wide stable-frontier bookkeeping for ONE node's view.
+
+    ``members`` are the peer identities this node must hear from (its
+    configured peer URLs — stable across crash/reboot because ports are);
+    the local node itself is the implicit extra member, read fresh at
+    mint time.  All methods are thread-safe (summaries arrive on gossip
+    threads; frontier() runs on the agent loop)."""
+
+    def __init__(self, node, members: List[str], *,
+                 max_staleness: float = 30.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 events=None):
+        self.node = node
+        self.members = list(members)
+        self.max_staleness = float(max_staleness)
+        self.clock = clock or time.monotonic
+        self.events = events
+        self._lock = threading.Lock()
+        # member -> {"vv": {rid: seq}, "frontier": {rid: seq}, "at": t}
+        self._observed: Dict[str, Dict[str, Any]] = {}
+        # last successfully minted frontier (gauges; {} before first mint)
+        self.last_frontier: Dict[int, int] = {}
+        # audit trail: one record per mint, with the summaries used
+        self.ledger: List[Dict[str, Any]] = []
+
+    def note(self, member: str, vv: Dict[int, int],
+             frontier: Dict[int, int]) -> None:
+        """Record a member's summary (from a captured stability header).
+        Watermarks are monotone facts, so a delayed/reordered summary is
+        merged pointwise rather than trusted to replace a newer one."""
+        now = self.clock()
+        with self._lock:
+            prev = self._observed.get(member)
+            if prev is not None:
+                vv = {r: max(s, prev["vv"].get(r, -1)) for r, s in vv.items()
+                      } | {r: s for r, s in prev["vv"].items() if r not in vv}
+                frontier = {
+                    r: max(s, prev["frontier"].get(r, -1))
+                    for r, s in frontier.items()
+                } | {r: s for r, s in prev["frontier"].items()
+                     if r not in frontier}
+            self._observed[member] = {"vv": vv, "frontier": frontier,
+                                      "at": now}
+
+    def observed(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {m: {"vv": dict(o["vv"]), "frontier": dict(o["frontier"]),
+                        "at": o["at"]} for m, o in self._observed.items()}
+
+    def stale_members(self, now: Optional[float] = None) -> List[str]:
+        """Members whose summary is missing or older than max_staleness —
+        nonempty means the frontier is stalled."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            out = []
+            for m in self.members:
+                o = self._observed.get(m)
+                if o is None or (now - o["at"]) > self.max_staleness:
+                    out.append(m)
+            return out
+
+    def frontier(self) -> Dict[int, int]:
+        """The fleet-stable frontier, or {} when it cannot be proven.
+
+        Pointwise min over (local vv, every member's fresh vv), subject to
+        the chain rule against all known folded frontiers — exactly
+        ``stable_frontier_host``.  Stalls (returns {}) loudly when any
+        member is silent or stale."""
+        # late import: api.net imports this module (header capture), so a
+        # module-level api.node import would be circular via api.__init__
+        from crdt_tpu.api.node import stable_frontier_host
+
+        stale = self.stale_members()
+        if stale:
+            if self.events is not None:
+                self.events.emit("stability_stalled",
+                                 stale=sorted(stale),
+                                 members=len(self.members))
+            return {}
+        own_vv, own_frontier = self.node.vv_snapshot()
+        with self._lock:
+            vvs = [own_vv] + [dict(self._observed[m]["vv"])
+                              for m in self.members]
+            frontiers = [own_frontier] + [dict(self._observed[m]["frontier"])
+                                          for m in self.members]
+        return stable_frontier_host(vvs, frontiers)
+
+    def mint(self, step: Optional[int] = None) -> Dict[int, int]:
+        """frontier() plus the audit-ledger record (GC coordinator path).
+        Empty mints are not recorded — the ledger is one row per frontier
+        the fleet was actually told to fold."""
+        frontier = self.frontier()
+        if not frontier:
+            return {}
+        with self._lock:
+            self.last_frontier = dict(frontier)
+            self.ledger.append({
+                "t": self.clock(),
+                "step": step,
+                "frontier": dict(frontier),
+                "summaries": {m: dict(o["vv"])
+                              for m, o in self._observed.items()},
+            })
+        return frontier
+
+    def lag_ops(self) -> int:
+        """Local vv ops minus last-minted-frontier ops: how much op-log
+        debt the fleet is carrying above the stable line."""
+        own_vv, _ = self.node.vv_snapshot()
+        with self._lock:
+            f = self.last_frontier
+            return (sum(s + 1 for s in own_vv.values())
+                    - sum(s + 1 for s in f.values()))

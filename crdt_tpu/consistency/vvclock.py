@@ -1,0 +1,71 @@
+"""VV-Clock: version-vector watermark lattice, array-encoded for TPU.
+
+The consistency plane's session tokens and stability summaries are all the
+same algebraic object: a per-writer "highest contiguous seq" watermark whose
+merge is pointwise max.  Host-side they live as ``{rid: seq}`` dicts
+(crdt_tpu.consistency.session), but the LATTICE they form is stated here as
+a first-class device model so crdtprove can machine-check the laws the whole
+plane leans on (token merge commutes, dominance is the lattice order, the
+stable frontier is the meet) instead of assuming them.
+
+Encoding
+--------
+``seqs: int32[..., n_writers]`` — one slot per writer rid, ``-1`` = "no op
+from this writer seen yet" (matching the ``vv.get(rid, -1)`` convention of
+crdt_tpu.api.node).  Leading axes batch tokens: a (sessions, writers) plane
+merges a fleet's worth of session tokens in one ``jnp.maximum``.
+
+join = elementwise max — commutative, associative, idempotent by
+construction, with ``zero`` (all ``-1``) the identity.  ``dominates`` is the
+induced partial order; ``meet`` (elementwise min) is the stable-frontier
+operator of crdt_tpu.consistency.stability, included so the frontier's
+"pointwise min over member watermarks" is checkable against the same model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class VVClock:
+    seqs: jax.Array  # int32[..., n_writers]; -1 = writer unseen
+
+    @property
+    def n_writers(self) -> int:
+        return self.seqs.shape[-1]
+
+
+def zero(n_writers: int, batch: tuple = (), dtype=jnp.int32) -> VVClock:
+    """Identity element of join: no writer seen (all -1)."""
+    return VVClock(seqs=jnp.full((*batch, n_writers), -1, dtype))
+
+
+def advance(c: VVClock, writer, seq) -> VVClock:
+    """Local op: witness writer's ops up through ``seq`` (inflationary:
+    the slot only ever moves up)."""
+    return VVClock(seqs=c.seqs.at[..., writer].max(seq))
+
+
+def join(a: VVClock, b: VVClock) -> VVClock:
+    return VVClock(seqs=jnp.maximum(a.seqs, b.seqs))
+
+
+def meet(a: VVClock, b: VVClock) -> VVClock:
+    """Greatest lower bound — the stable-frontier fold: every op at or
+    under the meet is provably held by both clocks' owners."""
+    return VVClock(seqs=jnp.minimum(a.seqs, b.seqs))
+
+
+def dominates(a: VVClock, b: VVClock) -> jax.Array:
+    """bool[...]: a >= b in the lattice order (a has seen everything b
+    has).  ``join(a, b) == a`` iff dominates(a, b) — the session-read
+    admission test."""
+    return (a.seqs >= b.seqs).all(axis=-1)
+
+
+def ops_known(c: VVClock) -> jax.Array:
+    """int32[...]: total ops under the watermark (sum of seq+1) — the
+    scalar behind the stability_frontier_ops / stability_lag_ops gauges."""
+    return (c.seqs + 1).sum(axis=-1)

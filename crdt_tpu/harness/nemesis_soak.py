@@ -20,7 +20,27 @@ and clock skew — then heals and asserts the CRDT laws held:
   quarantined (``snapshot_quarantine`` in the JSONL black box) and the
   node restores from the PREVIOUS generation (``snapshot_restore`` with
   ``fallback=true``); every wire-corruption that reached a node shows up
-  as a ``payload_quarantine`` event — degradation, never a dead loop.
+  as a ``payload_quarantine`` event — degradation, never a dead loop;
+* **stability-GC safety** (``--gc``) — the coordinator drives
+  fleet-coordinated op-log GC from the piggybacked stability frontier
+  (crdt_tpu.consistency) on a fixed cadence OUTSIDE the action rng, so a
+  SHADOW arm with GC disabled replays the identical action + fault
+  stream: the converged state and vv must be BIT-EQUAL between arms while
+  the GC arm retains strictly fewer raw commands.  Every mint is audited
+  against the tracker's ledger (frontier under every member's vouched
+  summary, summaries under the running-max true vv the driver recorded)
+  and after every round no op above a node's adopted frontier may be
+  missing from its raw command map — collected means strictly below;
+* **strong never-stale** (``--strong``) — a ``strong_op`` action mixes
+  linearizable reads and CAS (crdt_tpu.consistency.plane) into the fault
+  schedule.  Node clocks are re-pinned each step into disjoint ms bands
+  (one shared wall sample), so LWW order == mint order and the audit is
+  exact: a linearizable read may return ONLY the last quorum-committed
+  value or a still-outstanding indeterminate write — never anything
+  older.  Every client-caught ConsistencyUnavailable must match a
+  ``consistency_unavailable`` event 1:1 (down to the indeterminate
+  flag), and after heal both a linearizable read and a CAS must succeed
+  outright.
 
 Determinism: the fault log records step indices only (no wall clock, no
 URLs); circuit breakers run on a step-indexed clock and per-edge seeded
@@ -37,7 +57,9 @@ import json
 import pathlib
 import random
 import tempfile
-from typing import Dict, List, Optional
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
 
 from crdt_tpu.faults import (
     FaultPlane,
@@ -51,6 +73,60 @@ from crdt_tpu.obs import assemble, health
 from crdt_tpu.obs.events import read_jsonl
 from crdt_tpu.obs.provenance import BirthLedger, propagation_summary
 from crdt_tpu.utils.config import ClusterConfig
+
+# --strong clock pinning: every node runs a _BandClock whose now_ms lands
+# in the current step's private band [(step+1)*_TS_PIN_MS, ...), so ts
+# order == mint-step order — which is what makes the never-stale audit
+# exact: LWW can never resurrect an op minted in an earlier step over one
+# minted later.  ~300 steps * 2^20 ms stays well inside the int32 ms range
+# the oplog stores.
+_TS_PIN_MS = 1 << 20
+
+
+class _BandClock:
+    """HostClock stand-in for --strong: ``now_ms`` is banded per step while
+    ``epoch_ms`` stays a CONSTANT zero, shared by every node.
+
+    The constant epoch is the load-bearing part.  Mutating ``epoch_ms``
+    per step (the obvious way to band now_ms) silently re-times every op
+    already encoded: wire keys carry ABSOLUTE timestamps (``rel +
+    epoch``), the native WireStore caches them pre-encoded, and receivers
+    rebase with THEIR current epoch — so any epoch drift between encode
+    time and decode time shifts the op's stored timestamp on the receiving
+    node only, and the fleet's LWW winners diverge unrecoverably (dedup by
+    (rid, seq) means the damage is never repaired).  With epoch pinned at
+    zero on every node, abs == rel everywhere and every conversion —
+    cached, delayed, or redelivered — round-trips exactly."""
+
+    def __init__(self, band: int = 0):
+        self.epoch_ms = 0
+        self.band = int(band)
+        self._wall0 = int(time.time() * 1000)
+
+    def now_ms(self) -> int:
+        # real ms elapsed inside the run is tiny against the band width;
+        # the clamp keeps a pathologically slow run inside its band
+        off = int(time.time() * 1000) - self._wall0
+        return (self.band + 1) * _TS_PIN_MS + min(off, _TS_PIN_MS - 1)
+
+
+class _PlaneTime:
+    """Deterministic fake time for a consistency plane under the nemesis:
+    now() advances only through sleep(), so the plane's wait/poll loops
+    issue a replayable number of wire calls regardless of host speed —
+    the fault log stays byte-identical across same-seed runs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.t = 0.0
+
+    def now(self) -> float:
+        with self._lock:
+            return self.t
+
+    def sleep(self, s: float) -> None:
+        with self._lock:
+            self.t += s
 
 
 @dataclasses.dataclass
@@ -79,6 +155,21 @@ class NemesisReport:
     final_composite_keys: int = 0
     propagation: Dict[str, float] = dataclasses.field(default_factory=dict)
     blame_coverage: Optional[float] = None
+    # --gc arm accounting + the two-arm comparison inputs (state_json /
+    # final_vv / writes_ledger are captured on EVERY run so the GC-off
+    # shadow arm can be compared bit-for-bit; not printed in summary())
+    gc_mints: int = 0
+    gc_skips: int = 0
+    gc_retained: Optional[int] = None
+    gc_retained_shadow: Optional[int] = None
+    state_json: Optional[str] = None
+    final_vv: Optional[Dict[int, int]] = None
+    writes_ledger: Optional[Dict[int, int]] = None
+    # --strong accounting (client-side counts; audited 1:1 vs events)
+    strong_ok: int = 0
+    strong_unavailable: int = 0
+    strong_conflicts: int = 0
+    strong_indeterminate: int = 0
 
     def summary(self) -> str:
         faults = ", ".join(
@@ -103,6 +194,18 @@ class NemesisReport:
                      f"({self.shed_ops} ops turned away), "
                      f"{self.page_quarantines} corrupt pages quarantined, "
                      f"provenance 1:1")
+        if self.gc_mints or self.gc_skips:
+            prop += (f"; gc: {self.gc_mints} mints / {self.gc_skips} "
+                     f"stalled rounds, {self.gc_retained} raw commands "
+                     f"retained")
+            if self.gc_retained_shadow is not None:
+                prop += (f" vs {self.gc_retained_shadow} without GC "
+                         f"(bit-equal states)")
+        if self.strong_ok or self.strong_unavailable:
+            prop += (f"; strong: {self.strong_ok} ok, "
+                     f"{self.strong_unavailable} unavailable (1:1 events, "
+                     f"{self.strong_indeterminate} indeterminate), "
+                     f"{self.strong_conflicts} cas conflicts, never stale")
         return (
             f"seed {self.seed}: {self.steps} steps x {self.nodes} nodes — "
             f"{self.writes} writes, {self.pulls} pulls ({self.merges} "
@@ -185,13 +288,45 @@ class _Slot:
             for j, url in zip(self.peer_slots, self.peer_urls)
         }
         self.host.agent.peers = list(self.transports.values())
+        if self.soak.gc or self.soak.strong:
+            # the stability tracker's staleness windows age in plane
+            # steps (same time base as the breakers), and the consistency
+            # plane's wait loops run on fake seconds that advance only
+            # through sleep() — both replay identically under one seed
+            self.host.agent.stability.clock = lambda: float(plane.step)
+            ft = _PlaneTime()
+            self.host.consistency.clock = ft.now
+            self.host.consistency.sleep = ft.sleep
+        if self.soak.strong:
+            # banded mint timestamps over a constant zero epoch — installed
+            # after NodeHost restore (which re-applies the snapshot's
+            # epoch_ms, also zero for every strong incarnation) and before
+            # the server takes traffic
+            self.host.node.clock = _BandClock(band=int(plane.step))
         self.host.start_server()
 
-    def crash(self) -> None:
+    def crash(self, durable: Optional[bool] = None) -> None:
         """SIGKILL analogue: the server vanishes mid-conversation; no stop
         event, no final checkpoint — un-gossiped, un-snapshotted writes of
-        this incarnation die with it."""
+        this incarnation die with it.
+
+        Strong mode crashes fail-STOP, not fail-amnesia: a quorum ack
+        promises the op is on stable storage, so the never-stale audit is
+        only sound if acked state survives the crash.  The flush is a
+        direct atomic save (no FaultyDisk tearing — a torn fsync'd ack is
+        a different fault model).  ``durable=False`` keeps the amnesia
+        crash for the plant-and-recover scenario, whose fallback restore
+        deliberately drops a never-acked, never-gossiped write."""
         assert self.host is not None
+        if self.soak.strong if durable is None else durable:
+            from crdt_tpu.utils import checkpoint as ckpt
+
+            h = self.host
+            ckpt.save_node_atomic(
+                self.ckpt_dir, h.node, set_node=h.set_node,
+                seq_node=h.seq_node, map_node=h.map_node,
+                composite_node=h.composite_node,
+            )
         self.host.stop_server()
         self.host.node.events.close()
         self.host = None
@@ -202,18 +337,54 @@ class NemesisSoak:
     #: composite-mode key pool: small on purpose — contention on shared
     #: keys is what exercises concurrent upd/rem token races
     COMPOSITE_KEYS = ("alpha", "beta", "gamma", "delta")
+    #: strong-mode register pool: shared across all coordinators so CAS
+    #: conflicts and cross-node read-after-CAS actually happen
+    STRONG_KEYS = ("reg-a", "reg-b", "reg-c")
+    #: --gc drives one coordinated GC attempt every this many steps —
+    #: OUTSIDE the action rng, so the GC-off shadow arm replays the
+    #: identical action stream
+    GC_EVERY = 5
 
     def __init__(self, seed: int, nodes: int = 3, steps: int = 120,
                  fault_log: Optional[str] = None,
                  postmortem_dir: Optional[str] = None,
                  assemble_check: bool = False,
                  composite: bool = False,
-                 overload: bool = False):
+                 overload: bool = False,
+                 gc: bool = False,
+                 strong: bool = False):
         assert nodes >= 2, "nemesis needs a fleet (>= 2 nodes)"
+        assert not (strong and overload), (
+            "--strong and --overload use disjoint action tables; run them "
+            "as separate soaks"
+        )
         self.seed = seed
         self.steps = steps
         self.postmortem_dir = postmortem_dir
         self.assemble_check = assemble_check
+        # gc mode: stability-frontier GC rides the run on a fixed cadence;
+        # run_soak additionally replays a GC-off shadow arm and requires
+        # bit-equal convergence plus a strictly smaller retained log
+        self.gc = gc
+        # strong mode: linearizable reads + CAS join the action table,
+        # with clock pinning making the never-stale audit exact
+        self.strong = strong
+        # driver-side truth for the --gc summary audit: running pointwise
+        # max of every member's vv, sampled at the end of every step (a
+        # summary may lag but can never exceed this)
+        self.true_vv: Dict[str, Dict[int, int]] = {}
+        # --strong audit state: last quorum-committed value per register,
+        # plus the still-outstanding indeterminate writes that may land
+        self.strong_committed: Dict[str, Optional[str]] = {}
+        self.strong_pending: Dict[str, set] = {}
+        self.strong_view: Dict[str, Optional[str]] = {}
+        self.strong_gen = 0
+        # --strong prefix-oracle journal: per-rid mint-ordered op list
+        # (kind, key, value) with a global order stamp — CAS ops share
+        # the rid seq space with plain writes, so the vv prefix is a walk
+        # of this journal rather than a k{rid}-{seq} count
+        self.minted: Dict[int, List[Tuple[int, str, str, str]]] = {}
+        self.mint_order = 0
         # overload mode: writes also arrive as admission BURSTS through
         # each host's ingest front door, against a deliberately tiny
         # high-water mark — sheds must be client-visible (ShedError, the
@@ -232,7 +403,12 @@ class NemesisSoak:
         self.composite = composite
         self._tmp = tempfile.TemporaryDirectory(prefix="nemesis_soak_")
         self.root = self._tmp.name
-        self.schedule = NemesisSchedule.generate(seed, nodes, steps)
+        # strong mode disables schedule clock skew: linearizable CAS over
+        # an LWW register needs ts order == mint order, which the per-step
+        # clock pinning provides and a skew event would re-break.  Skew
+        # tolerance stays pinned by the default soak.
+        self.schedule = NemesisSchedule.generate(
+            seed, nodes, steps, clock_skew=not strong)
         self.plane = FaultPlane(self.schedule, log_path=fault_log)
         # fleet-shared birth ledger: every slot's flight recorder converts
         # newly-visible seqs to step lags against it (obs/provenance)
@@ -245,6 +421,11 @@ class NemesisSoak:
             # size-triggered drain can relieve it
             ingest_kw = dict(ingest_flush_ops=64, ingest_flush_ms=5.0,
                              ingest_high_water=24, ingest_retry_after_s=0.01)
+        if strong:
+            # fake-clock budget per strong op: the catch-up loop polls at
+            # most timeout/poll times, so a stuck op costs a bounded,
+            # replayable number of proxy rounds before its loud 503
+            ingest_kw.update(strong_timeout_s=2.0, session_poll_s=0.25)
         self.config = ClusterConfig(
             n_replicas=nodes, seed=seed,
             gossip_period_ms=600_000,  # external drive only (determinism)
@@ -292,6 +473,19 @@ class NemesisSoak:
         if slot.host.node.add_command({f"k{rid}-{seq}": f"v{rid}-{seq}"}):
             self.writes[rid] = seq + 1
             self.report.writes += 1
+            self._journal(rid, "kv", f"k{rid}-{seq}", f"v{rid}-{seq}")
+
+    def _journal(self, rid: int, kind: str, key: str, value: str) -> None:
+        """Strong-mode mint journal: CAS ops share each rid's seq space
+        with plain writes, so the prefix oracle walks this per-rid,
+        mint-ordered journal instead of counting k{rid}-{seq} keys.  The
+        global order stamp resolves shared strong registers: with pinned
+        clocks, LWW order == mint order."""
+        if not self.strong:
+            return
+        self.mint_order += 1
+        self.minted.setdefault(rid, []).append(
+            (self.mint_order, kind, key, value))
 
     def _overload_burst(self) -> None:
         """Admission burst through a live host's ingest front door, against
@@ -413,8 +607,82 @@ class NemesisSoak:
         if coord.alive and coord.host.agent.compact_once():
             self.report.barriers += 1
 
+    def _pin_clocks(self, step: int) -> None:
+        """Strong mode: advance every live node's _BandClock to this
+        step's private band.  epoch_ms never moves (see _BandClock: a
+        moving epoch desyncs the cached wire encodings and diverges LWW);
+        only the band of freshly minted timestamps does."""
+        for s in self._alive():
+            s.host.node.clock.band = int(step)
+
+    def _strong_op(self) -> None:
+        """One linearizable read or CAS through a live host's consistency
+        plane (its quorum legs cross the FaultyTransports).  Every outcome
+        feeds the never-stale audit; every ConsistencyUnavailable is
+        counted for the 1:1 event reconciliation after heal."""
+        from crdt_tpu.consistency import CasConflict, ConsistencyUnavailable
+
+        slot = self.rng.choice(self._alive())
+        cons = slot.host.consistency
+        key = self.rng.choice(self.STRONG_KEYS)
+        if self.rng.random() < 0.5:
+            try:
+                val = cons.read(key, level="linearizable")
+            except ConsistencyUnavailable:
+                self.report.strong_unavailable += 1
+                return
+            self.report.strong_ok += 1
+            self._audit_strong(key, val, op="read")
+            self.strong_view[key] = val
+            return
+        self.strong_gen += 1
+        new = f"g{self.strong_gen}"
+        rid = slot.host.node.rid
+        try:
+            cons.cas(key, self.strong_view.get(key), new)
+        except CasConflict as e:
+            # the conflict's ACTUAL rode the same quorum read — audit it
+            # like any linearizable result, then adopt it as our view
+            self.report.strong_conflicts += 1
+            self._audit_strong(key, e.actual, op="cas_conflict")
+            self.strong_view[key] = e.actual
+            return
+        except ConsistencyUnavailable as e:
+            self.report.strong_unavailable += 1
+            if e.indeterminate:
+                # minted locally but not quorum-acked: the op may still
+                # land via anti-entropy.  The driver is single-threaded,
+                # so the rid's newest seq IS this op — journal it (it
+                # occupies vv space) and allow its value until the next
+                # committed CAS supersedes it (pinned ts ⇒ later commits
+                # always win LWW).
+                self.report.strong_indeterminate += 1
+                self.strong_pending.setdefault(key, set()).add(new)
+                self._journal(rid, "strong", key, new)
+            return
+        self.report.strong_ok += 1
+        self._journal(rid, "strong", key, new)
+        self.strong_committed[key] = new
+        self.strong_pending[key] = set()
+        self.strong_view[key] = new
+
+    def _audit_strong(self, key: str, val: Optional[str], op: str) -> None:
+        """The never-stale oracle: a linearizable result may only be the
+        last quorum-committed value or a still-outstanding indeterminate
+        write.  Anything older means a strong read silently served stale
+        state — exactly what the 503 posture forbids."""
+        allowed = ({self.strong_committed.get(key)}
+                   | self.strong_pending.get(key, set()))
+        assert val in allowed, (
+            f"stale {op} on {key!r}: got {val!r}, but only "
+            f"{sorted(x if x is not None else '<absent>' for x in allowed)} "
+            f"are linearizable (committed or indeterminate-outstanding)"
+        )
+
     def step(self, step: int) -> None:
         self.plane.step = step
+        if self.strong:
+            self._pin_clocks(step)
         for skew in self.plane.skews_at(step):
             slot = self.slots[int(skew.node)]
             if slot.alive:
@@ -429,6 +697,12 @@ class NemesisSoak:
                  "barrier", "overload_burst"),
                 weights=(27, 33, 8, 4, 6, 2, 20),
             )[0]
+        elif self.strong:
+            action = self.rng.choices(
+                ("write", "pull", "checkpoint", "crash", "reboot",
+                 "barrier", "strong_op"),
+                weights=(35, 33, 8, 4, 6, 2, 12),
+            )[0]
         else:
             action = self.rng.choices(
                 ("write", "pull", "checkpoint", "crash", "reboot",
@@ -436,6 +710,189 @@ class NemesisSoak:
                 weights=(45, 35, 8, 4, 6, 2),
             )[0]
         getattr(self, f"_{action}")()
+        if self.gc:
+            # the GC drive and truth sampling sit OUTSIDE the action rng:
+            # the GC-off shadow arm consumes the identical random stream
+            if step % self.GC_EVERY == 0:
+                self._drive_gc(step)
+            self._sample_true_vvs()
+
+    # ---- --gc: coordinated GC drive + the safety oracle ----
+
+    def _url_of(self, slot: "_Slot") -> str:
+        return f"http://127.0.0.1:{slot.port}"
+
+    def _sample_true_vvs(self) -> None:
+        """Fold every live node's vv into the driver's running-max truth
+        (keyed by member URL — the tracker's member identity).  Sampled at
+        the end of every step, so any summary the coordinator captured can
+        claim at most what some incarnation actually held."""
+        for s in self._alive():
+            acc = self.true_vv.setdefault(self._url_of(s), {})
+            for r, q in s.host.node.version_vector().items():
+                if q > acc.get(r, -1):
+                    acc[r] = q
+
+    def _drive_gc(self, step: int) -> None:
+        """One coordinated GC attempt through the coordinator's agent,
+        followed by the mint audit: the minted frontier must sit under the
+        coordinator's own vv AND under every member's vouched summary, and
+        every summary must sit under the running-max true vv the driver
+        recorded — a tracker that ever invents stability fails here, not
+        in a converged-state diff three phases later."""
+        coord = self.slots[0]
+        if not coord.alive:
+            self.report.gc_skips += 1
+            return
+        self._sample_true_vvs()
+        tracker = coord.host.agent.stability
+        own_vv = coord.host.node.version_vector()
+        n_ledger = len(tracker.ledger)
+        frontier = coord.host.agent.stability_gc_once(step=step)
+        if not frontier:
+            self.report.gc_skips += 1
+            return
+        self.report.gc_mints += 1
+        assert len(tracker.ledger) == n_ledger + 1, (
+            "mint without a matching audit-ledger record"
+        )
+        rec = tracker.ledger[-1]
+        assert rec["frontier"] == frontier and rec["step"] == step, rec
+        for r, q in frontier.items():
+            assert q <= own_vv.get(r, -1), (
+                f"minted frontier claims ({r},{q}) beyond the "
+                f"coordinator's own vv {own_vv}"
+            )
+        for m in tracker.members:
+            summ = rec["summaries"].get(m)
+            assert summ is not None, (
+                f"frontier minted without a summary from member {m}"
+            )
+            for r, q in frontier.items():
+                assert q <= summ.get(r, -1), (
+                    f"minted frontier claims ({r},{q}) but member {m} "
+                    f"only vouched for {summ}"
+                )
+        for m, summ in rec["summaries"].items():
+            truth = self.true_vv.get(m, {})
+            for r, q in summ.items():
+                assert q <= truth.get(r, -1), (
+                    f"summary from {m} claims ({r},{q}) beyond any vv "
+                    f"that member ever held ({truth.get(r, -1)}): "
+                    "stability header forged or tracker merged garbage"
+                )
+        self._check_gc_collection()
+
+    def _check_gc_collection(self) -> None:
+        """Collected-means-strictly-below, checked on every live node: any
+        op the vv covers ABOVE the node's adopted frontier must still be
+        present as a raw command — compaction may only ever fold what the
+        frontier proves fleet-stable."""
+        for s in self._alive():
+            n = s.host.node
+            vv = n.version_vector()
+            f = dict(n._frontier)
+            held = {(k[1], k[2]) for k in n._commands}
+            for r, upto in vv.items():
+                for q in range(f.get(r, -1) + 1, upto + 1):
+                    assert (r, q) in held, (
+                        f"slot {s.slot}: op ({r},{q}) above the adopted "
+                        f"frontier {f.get(r, -1)} is missing from the raw "
+                        "command map — an unstable op was collected"
+                    )
+
+    def _gc_final(self) -> None:
+        """Post-heal coordinated GC: age the breakers shut with clean pull
+        rounds, then one mint over the fully-converged, fully-fresh fleet
+        — it MUST succeed, its frontier is the converged vv, and every
+        node's raw command map must empty (the measured footprint win the
+        report quotes against the shadow arm)."""
+        for _ in range(6):  # > breaker backoff cap: every circuit closes
+            self.plane.step += 1
+            for src in self.slots:
+                for dst in src.peer_slots:
+                    t = src.transports[dst]
+                    if not t.backed_off():
+                        src.host.agent.pull_from(t)
+        before = self.report.gc_mints
+        self._drive_gc(self.plane.step)
+        assert self.report.gc_mints == before + 1, (
+            "post-heal GC round failed to mint despite a converged, "
+            "fully-fresh fleet (tracker stalled on stale summaries?)"
+        )
+        vv = self.slots[0].host.node.version_vector()
+        minted = self.slots[0].host.agent.stability.last_frontier
+        assert minted == vv, (
+            f"post-heal frontier {minted} != converged vv {vv}"
+        )
+        for s in self.slots:
+            assert len(s.host.node._commands) == 0, (
+                f"slot {s.slot} still retains "
+                f"{len(s.host.node._commands)} raw commands after the "
+                "full-vv fold"
+            )
+
+    # ---- --strong: post-heal recovery + event reconciliation ----
+
+    def _check_strong_recovery(self) -> None:
+        """After heal, strong operations must come back OUTRIGHT: age the
+        breakers shut, then a linearizable read, a CAS, and a read-back
+        on slot 0 — any ConsistencyUnavailable here is a recovery bug."""
+        for _ in range(6):
+            self.plane.step += 1
+            self._pin_clocks(self.plane.step)
+            for src in self.slots:
+                for dst in src.peer_slots:
+                    t = src.transports[dst]
+                    if not t.backed_off():
+                        src.host.agent.pull_from(t)
+        slot = self.slots[0]
+        cons = slot.host.consistency
+        key = self.STRONG_KEYS[0]
+        val = cons.read(key, level="linearizable")
+        self._audit_strong(key, val, op="recovery_read")
+        self.strong_gen += 1
+        new = f"g{self.strong_gen}"
+        cons.cas(key, val, new)
+        self._journal(slot.host.node.rid, "strong", key, new)
+        self.strong_committed[key] = new
+        self.strong_pending[key] = set()
+        self.strong_view[key] = new
+        got = cons.read(key, level="linearizable")
+        assert got == new, (
+            f"post-heal CAS wrote {new!r} but the linearizable read-back "
+            f"returned {got!r}"
+        )
+
+    def _check_strong_provenance(self) -> None:
+        """The never-silent contract for strong ops, audited 1:1 like the
+        shed ledger: every ConsistencyUnavailable the driver caught must
+        appear as a ``consistency_unavailable`` event in some node's black
+        box — same total, same indeterminate split.  And a strong soak
+        that never lost a quorum (or never completed an op) tested
+        nothing, so both counts must be positive."""
+        events = []
+        for s in self.slots:
+            events.extend(e for e in read_jsonl(s.event_log_path)
+                          if e.get("event") == "consistency_unavailable")
+        assert len(events) == self.report.strong_unavailable, (
+            f"driver caught {self.report.strong_unavailable} "
+            f"ConsistencyUnavailable but the black boxes recorded "
+            f"{len(events)} consistency_unavailable events"
+        )
+        ind = sum(1 for e in events if e.get("indeterminate"))
+        assert ind == self.report.strong_indeterminate, (
+            f"{self.report.strong_indeterminate} indeterminate CAS "
+            f"outcomes vs {ind} indeterminate events"
+        )
+        assert self.report.strong_unavailable > 0, (
+            "strong soak never lost a quorum: faults too mild to pin the "
+            "503 posture"
+        )
+        assert self.report.strong_ok > 0, (
+            "strong soak never completed a strong op: quorum settings or "
+            "timeouts dead"
+        )
 
     # ---- heal phase: recovery provenance + convergence + oracle ----
 
@@ -459,13 +916,14 @@ class NemesisSoak:
         if h.node.add_command({f"k{rid}-{seq}": f"v{rid}-{seq}"}):
             self.writes[rid] = seq + 1
             self.report.writes += 1
+            self._journal(rid, "kv", f"k{rid}-{seq}", f"v{rid}-{seq}")
         snap_b, _ = slot.disk.save(
             slot.ckpt_dir, h.node, set_node=h.set_node,
             seq_node=h.seq_node, map_node=h.map_node,
             composite_node=h.composite_node,
         )
         self.report.checkpoints += 2
-        slot.crash()
+        slot.crash(durable=False)
         torn = plant_corruption(
             slot.ckpt_dir, rng=random.Random(f"nemesis-plant:{self.seed}"))
         assert torn == snap_b, (torn, snap_b)
@@ -533,7 +991,55 @@ class NemesisSoak:
             f"heal (seed {self.seed})"
         )
 
+    def _check_prefix_oracle_strong(self) -> None:
+        """Strong-mode prefix oracle: CAS mints share each rid's seq space
+        with plain writes, so the expected state is a walk of the per-rid
+        mint journal up to the vv — unique kv keys fold directly, shared
+        strong registers resolve by global mint order (pinned clocks make
+        LWW order == mint order)."""
+        state = self.slots[0].host.node.get_state()
+        vv = self.slots[0].host.node.version_vector()
+        expected: Dict[str, str] = {}
+        strong_winner: Dict[str, Tuple[int, str]] = {}
+        for rid, entries in sorted(self.minted.items()):
+            upto = vv.get(rid, -1)
+            assert upto < len(entries), (
+                f"fleet vv claims seq {upto} for writer {rid}, which only "
+                f"minted {len(entries)} ops (ghost writes)"
+            )
+            for i, (order, kind, key, val) in enumerate(entries):
+                if i > upto:
+                    if kind == "kv":
+                        assert key not in state, (
+                            f"{key} present above the vv prefix (seq {i} "
+                            f"> {upto}): contiguity broken"
+                        )
+                    continue
+                if kind == "kv":
+                    expected[key] = val
+                elif order > strong_winner.get(key, (-1, ""))[0]:
+                    strong_winner[key] = (order, val)
+        for key, (_, val) in strong_winner.items():
+            expected[key] = val
+        assert state == expected, (
+            "converged state != vv-prefix fold of the mint journal: "
+            f"missing={sorted(set(expected) - set(state))[:5]} "
+            f"extra={sorted(set(state) - set(expected))[:5]} "
+            f"wrong={sorted(k for k in set(state) & set(expected) if state[k] != expected[k])[:5]}"
+        )
+        for s in self.slots:
+            rid = s.host.node.rid
+            if rid in self.minted:
+                assert vv.get(rid, -1) == len(self.minted[rid]) - 1, (
+                    f"live writer {rid} lost writes: vv={vv.get(rid)} "
+                    f"journal={len(self.minted[rid])}"
+                )
+        self.report.final_keys = len(state)
+
     def _check_prefix_oracle(self) -> None:
+        if self.strong:
+            self._check_prefix_oracle_strong()
+            return
         state = self.slots[0].host.node.get_state()
         vv = self.slots[0].host.node.version_vector()
         expected = {}
@@ -675,12 +1181,31 @@ class NemesisSoak:
                 s.boot()
                 self.report.reboots += 1
         self._plant_and_recover()
+        if self.strong:
+            # advance every node (including just-rebooted slots, whose
+            # _BandClock was born at the plane's current step) into one
+            # shared heal band above the whole run
+            self._pin_clocks(self.steps)
         self._converge(max_rounds)
+        if self.strong:
+            self._check_strong_recovery()
+        if self.gc:
+            self._gc_final()
         self._check_prefix_oracle()
         self._check_idempotence()
         self._check_quarantine_provenance()
+        if self.strong:
+            self._check_strong_provenance()
         if self.overload:
             self._check_shed_provenance()
+        # two-arm comparison inputs, captured on EVERY run: the --gc
+        # shadow arm is diffed bit-for-bit against these
+        self.report.state_json = json.dumps(
+            self.slots[0].host.node.get_state(), sort_keys=True)
+        self.report.final_vv = dict(self.slots[0].host.node.version_vector())
+        self.report.writes_ledger = dict(self.writes)
+        self.report.gc_retained = sum(
+            len(s.host.node._commands) for s in self.slots)
         if self.composite:
             self.report.final_composite_keys = len(
                 self.slots[0].host.composite_node.items())
@@ -760,11 +1285,48 @@ def run_soak(seed: int, nodes: int, steps: int,
              postmortem_dir: Optional[str] = None,
              assemble_check: bool = False,
              composite: bool = False,
-             overload: bool = False) -> NemesisReport:
-    return NemesisSoak(seed, nodes=nodes, steps=steps,
-                       fault_log=fault_log, postmortem_dir=postmortem_dir,
-                       assemble_check=assemble_check,
-                       composite=composite, overload=overload).run()
+             overload: bool = False,
+             gc: bool = False,
+             strong: bool = False) -> NemesisReport:
+    rep = NemesisSoak(seed, nodes=nodes, steps=steps,
+                      fault_log=fault_log, postmortem_dir=postmortem_dir,
+                      assemble_check=assemble_check,
+                      composite=composite, overload=overload,
+                      gc=gc, strong=strong).run()
+    if gc:
+        # shadow arm: the IDENTICAL soak with GC never driven.  The GC
+        # drive sits outside the action rng and the fault coins are pure
+        # functions of (seed, step, edge, rule), so both arms replay the
+        # same writes and the same fault decisions — coordinated GC must
+        # change NOTHING about the converged lattice, only the footprint.
+        shadow = NemesisSoak(seed, nodes=nodes, steps=steps,
+                             postmortem_dir=postmortem_dir,
+                             composite=composite, overload=overload,
+                             gc=False, strong=strong).run()
+        assert rep.writes_ledger == shadow.writes_ledger, (
+            f"seed {seed}: GC arm minted {rep.writes_ledger} but the "
+            f"shadow minted {shadow.writes_ledger} — the GC drive leaked "
+            "into the action rng stream"
+        )
+        assert rep.final_vv == shadow.final_vv, (
+            f"seed {seed}: converged vv differs with GC on "
+            f"({rep.final_vv}) vs off ({shadow.final_vv})"
+        )
+        assert rep.state_json == shadow.state_json, (
+            f"seed {seed}: converged state is NOT bit-equal with GC on "
+            f"vs off ({len(rep.state_json)} vs {len(shadow.state_json)} "
+            "bytes) — compaction changed the lattice"
+        )
+        assert rep.gc_mints > 0, (
+            f"seed {seed}: gc soak never minted a frontier; oracle "
+            "exercised nothing"
+        )
+        assert rep.gc_retained < shadow.gc_retained, (
+            f"seed {seed}: GC arm retained {rep.gc_retained} raw commands "
+            f"vs {shadow.gc_retained} without GC — no footprint win"
+        )
+        rep.gc_retained_shadow = shadow.gc_retained
+    return rep
 
 
 def main(argv=None) -> int:
@@ -795,6 +1357,17 @@ def main(argv=None) -> int:
                          "high-water mark and require every shed to be "
                          "black-boxed 1:1 (client 429s == ingest_shed "
                          "events, down to the op totals)")
+    ap.add_argument("--gc", action="store_true",
+                    help="drive stability-frontier GC on a fixed cadence "
+                         "and replay a GC-off shadow arm: converged state "
+                         "must be bit-equal, no op above a minted "
+                         "frontier may ever be collected (ledger audit), "
+                         "and the retained op log must shrink")
+    ap.add_argument("--strong", action="store_true",
+                    help="mix linearizable reads + CAS into the schedule: "
+                         "strong ops must 503 (never serve stale) during "
+                         "quorum loss, match consistency_unavailable "
+                         "events 1:1, and recover outright after heal")
     ap.add_argument("--race-check", action="store_true",
                     help="run under the witnessed-race detector "
                          "(analysis.verify.race) and fail on any "
@@ -816,11 +1389,13 @@ def main(argv=None) -> int:
                                postmortem_dir=args.postmortem_dir,
                                assemble_check=args.assemble_check,
                                composite=args.composite,
-                               overload=args.overload)
+                               overload=args.overload,
+                               gc=args.gc, strong=args.strong)
                 run_soak(seed, args.nodes, args.steps, fault_log=log_b,
                          postmortem_dir=args.postmortem_dir,
                          composite=args.composite,
-                         overload=args.overload)
+                         overload=args.overload,
+                         gc=args.gc, strong=args.strong)
                 a = pathlib.Path(log_a).read_bytes()
                 b = pathlib.Path(log_b).read_bytes()
                 assert a == b, (
@@ -834,7 +1409,8 @@ def main(argv=None) -> int:
                            postmortem_dir=args.postmortem_dir,
                            assemble_check=args.assemble_check,
                            composite=args.composite,
-                           overload=args.overload)
+                           overload=args.overload,
+                           gc=args.gc, strong=args.strong)
             print(f"[nemesis] {rep.summary()}")
         if args.race_check:
             rpt = race.report()

@@ -172,6 +172,28 @@ def sample_peer_circuits(registry, node_label: str, peers) -> None:
     registry.set_gauge("net_peers_total", len(peers), node=node_label)
 
 
+def sample_stability(registry, node_label: str, tracker) -> None:
+    """Stability-frontier gauges (crdt_tpu.consistency.stability):
+    ``stability_frontier_ops`` — total ops under the last minted fleet
+    frontier; ``stability_lag_ops`` — local vv ops minus that frontier
+    (the op-log debt the fleet carries above the stable line; grows
+    monotonically while GC is stalled — THE alert signal for a
+    partitioned member freezing collection); ``stability_stale_peers`` —
+    members currently blocking a mint.  The companion counter
+    ``crdt_gc_reclaimed_ops_total`` is inc'd at prune time
+    (ReplicaNode._prune_commands_locked) and the
+    ``strong_read_quorum_seconds`` histogram at the consistency plane —
+    both render from the registry without sampling here."""
+    registry.set_gauge(
+        "stability_frontier_ops",
+        sum(s + 1 for s in tracker.last_frontier.values()),
+        node=node_label)
+    registry.set_gauge("stability_lag_ops", tracker.lag_ops(),
+                       node=node_label)
+    registry.set_gauge("stability_stale_peers",
+                       len(tracker.stale_members()), node=node_label)
+
+
 def sample_race_watch(registry) -> None:
     """Witnessed-race detector gauges (analysis.verify.race): the current
     witness count plus per-watchpoint read/write traffic, so a soak run
@@ -190,7 +212,7 @@ def sample_race_watch(registry) -> None:
 
 def sample_all(registry, node, set_node=None, seq_node=None,
                map_node=None, composite_node=None, agent=None,
-               ingest=None) -> None:
+               ingest=None, stability=None) -> None:
     sample_kv_node(registry, node)
     if set_node is not None:
         sample_set_node(registry, set_node)
@@ -204,15 +226,17 @@ def sample_all(registry, node, set_node=None, seq_node=None,
         sample_peer_circuits(registry, str(node.rid), agent.peers)
     if ingest is not None:
         sample_ingest(registry, ingest)
+    if stability is not None:
+        sample_stability(registry, str(node.rid), stability)
 
 
 def render_node_metrics(node, set_node=None, seq_node=None,
                         map_node=None, composite_node=None,
-                        agent=None, ingest=None) -> str:
+                        agent=None, ingest=None, stability=None) -> str:
     """The GET /metrics body: sample health gauges into the node's
     registry, then render the whole registry as Prometheus text."""
     registry = node.metrics.registry
     sample_all(registry, node, set_node=set_node, seq_node=seq_node,
                map_node=map_node, composite_node=composite_node,
-               agent=agent, ingest=ingest)
+               agent=agent, ingest=ingest, stability=stability)
     return registry.render_prometheus()

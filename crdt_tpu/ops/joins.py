@@ -308,6 +308,17 @@ def _register_builtin_joins() -> None:
                   rand=rs.rand_pncounter,
                   small=rs.small_pncounter,
                   structurally_commutative=True)
+    # the consistency plane's watermark lattice (session tokens, stability
+    # summaries, the stable frontier's meet — crdt_tpu.consistency): its
+    # laws are what make token merges order-free and staleness safe, so it
+    # verifies like any other model
+    from crdt_tpu.consistency import vvclock
+
+    register_join("vvclock", vvclock.join,
+                  neutral=lambda: vvclock.zero(8),
+                  rand=rs.rand_vvclock,
+                  small=rs.small_vvclock,
+                  structurally_commutative=True)
     register_join("lww", lww.join,
                   neutral=lww.zero, rand=rs.rand_lww,
                   small=rs.small_lww)

@@ -85,6 +85,14 @@ def rand_mvregister(rng, n_writers: int = 4):
     )
 
 
+def rand_vvclock(rng, n_writers: int = 8):
+    from crdt_tpu.consistency import vvclock
+
+    # -1 = writer unseen (the vv.get(rid, -1) convention); any value >= -1
+    # is reachable, so the draw spans the whole encoding
+    return vvclock.VVClock(seqs=_i32(rng, -1, 20, (n_writers,)))
+
+
 def rand_token_plane(rng, n_writers: int = 4):
     from crdt_tpu.models import flags
 
@@ -271,6 +279,21 @@ def small_pncounter(n_nodes: int = 8, vals=(0, 1), slots: int = 2):
     return out
 
 
+def small_vvclock(n_writers: int = 8, vals=(-1, 0, 1), slots: int = 2):
+    """Every watermark over ``vals`` on the first ``slots`` writers (rest
+    unseen = -1): the complete 2-writer vv-clock instance embedded at the
+    registered shape — covers the unseen/-1 boundary the session-token
+    dominance checks lean on."""
+    from crdt_tpu.consistency import vvclock
+
+    out = []
+    for combo in itertools.product(vals, repeat=slots):
+        seqs = [-1] * n_writers
+        seqs[:slots] = combo
+        out.append(vvclock.VVClock(seqs=jnp.asarray(seqs, jnp.int32)))
+    return out
+
+
 def small_lww():
     """zero plus every write with ts in {0,1,2} x rid in {0,1}
     (payload-from-identity keeps independent seeds consistent)."""
@@ -332,6 +355,7 @@ def small_seeded(rand_fn, n: int = 5, seed: int = 0, **kw):
 BUILTIN_RAND = {
     "gcounter": rand_gcounter,
     "pncounter": rand_pncounter,
+    "vvclock": rand_vvclock,
     "lww": rand_lww,
     "lww_packed": rand_lww_packed,
     "mvregister": rand_mvregister,

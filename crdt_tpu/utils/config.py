@@ -110,6 +110,25 @@ class ClusterConfig:
     # advisory Retry-After (seconds) served with a shed
     ingest_retry_after_s: float = 0.05
 
+    # ---- consistency plane (crdt_tpu.consistency) ----
+    # gossip rounds between stability-GC attempts on the coordinator
+    # (replica 0); 0 disables fleet-coordinated GC.  Unlike compact_every
+    # (a blocking vv-collection barrier), this mints the frontier from
+    # summaries piggybacked on gossip headers — no extra round trips
+    stability_gc_every: int = 0
+    # a member whose piggybacked summary is older than this (tracker
+    # clock seconds) STALLS the frontier — GC freezes loudly instead of
+    # advancing past a partitioned/dead peer
+    stability_max_staleness_s: float = 30.0
+    # acks required by linearizable reads / CAS; 0 = majority of the
+    # fleet (peers + self)
+    strong_quorum: int = 0
+    # deadline for one strong operation (quorum round + catch-up pulls)
+    strong_timeout_s: float = 5.0
+    # deadline for a session read's dominance wait, and its poll cadence
+    session_wait_s: float = 5.0
+    session_poll_s: float = 0.02
+
     def ports(self) -> List[int]:
         return [self.base_port + i for i in range(self.n_replicas)]
 

@@ -1,0 +1,488 @@
+"""Consistency plane: stability-frontier math, session tokens, quorum
+reads/CAS — the deterministic (fake clock, fake peers) half of what the
+nemesis --gc / --strong soaks audit end-to-end.
+
+Every wait loop in the plane takes injectable ``clock``/``sleep``, so the
+timeout paths here run in microseconds of wall time: the fake clock only
+advances when the code under test sleeps.
+"""
+from __future__ import annotations
+
+import pytest
+
+from crdt_tpu.api.node import ReplicaNode, stable_frontier_host
+from crdt_tpu.consistency import (
+    CasConflict,
+    ConsistencyPlane,
+    ConsistencyUnavailable,
+    StabilityTracker,
+    decode_summary,
+    decode_token,
+    encode_summary,
+    encode_token,
+    mint_token,
+    token_join,
+    vv_dominates,
+    wait_for_dominance,
+)
+from crdt_tpu.ingest.admission import IngestFrontDoor
+from crdt_tpu.obs.events import EventLog
+
+
+class FakeTime:
+    """Manual clock + sleep: time advances only when the code sleeps."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = 0
+
+    def now(self) -> float:
+        return self.t
+
+    def sleep(self, dt: float) -> None:
+        self.sleeps += 1
+        self.t += max(dt, 1e-6)
+
+
+class FakePeer:
+    """RemotePeer stand-in over a backing ReplicaNode, with switches for
+    every failure posture the plane distinguishes: an OPEN breaker
+    (``backed``), a dead transport (``down``), a peer that acks vv probes
+    but cannot serve deltas (``serve_deltas=False``), and a peer that
+    drops synchronous pushes (``accept_push=False``)."""
+
+    def __init__(self, node: ReplicaNode, url: str = "peer"):
+        self.node = node
+        self.url = url
+        self.backed = False
+        self.down = False
+        self.serve_deltas = True
+        self.accept_push = True
+        self.vv_calls = 0
+
+    def backed_off(self) -> bool:
+        return self.backed
+
+    def version_vector(self):
+        self.vv_calls += 1
+        if self.down:
+            return None
+        return self.node.vv_snapshot()
+
+    def gossip_payload(self, since=None):
+        if self.down or not self.serve_deltas:
+            return None
+        return self.node.gossip_payload(since=since)
+
+    def push_payload(self, payload) -> bool:
+        if self.down or not self.accept_push:
+            return False
+        self.node.receive(payload)
+        return True
+
+
+def mk_node(rid: int) -> ReplicaNode:
+    return ReplicaNode(rid=rid, capacity=64)
+
+
+def mk_plane(node: ReplicaNode, peers, ft: FakeTime, **kw) -> ConsistencyPlane:
+    kw.setdefault("strong_timeout", 0.2)
+    kw.setdefault("session_timeout", 0.2)
+    kw.setdefault("poll", 0.02)
+    return ConsistencyPlane(node, peers=lambda: peers,
+                            clock=ft.now, sleep=ft.sleep, **kw)
+
+
+class StubNode:
+    """vv_snapshot-only node for pure frontier-math tests."""
+
+    def __init__(self, vv, frontier=None):
+        self.vv = dict(vv)
+        self.frontier = dict(frontier or {})
+
+    def vv_snapshot(self):
+        return dict(self.vv), dict(self.frontier)
+
+
+# ---------------------------------------------------------------- stability
+
+
+def test_frontier_stalls_without_summaries():
+    ft = FakeTime()
+    ev = EventLog(node="t")
+    tr = StabilityTracker(StubNode({0: 5}), ["a", "b"], clock=ft.now,
+                          events=ev)
+    assert tr.frontier() == {}
+    assert tr.stale_members() == ["a", "b"]
+    [rec] = ev.find(event="stability_stalled")
+    assert rec["stale"] == ["a", "b"]
+
+
+def test_frontier_is_pointwise_min_over_fleet():
+    ft = FakeTime()
+    tr = StabilityTracker(StubNode({0: 5, 1: 9}), ["a", "b"], clock=ft.now)
+    tr.note("a", {0: 3, 1: 9}, {})
+    tr.note("b", {0: 5, 1: 7}, {})
+    # per-writer min across (local, a, b); writer 2 unseen anywhere
+    assert tr.frontier() == {0: 3, 1: 7}
+
+
+def test_frontier_partial_view_drops_unseen_writers():
+    ft = FakeTime()
+    tr = StabilityTracker(StubNode({0: 5, 2: 4}), ["a"], clock=ft.now)
+    tr.note("a", {0: 2}, {})  # a has never heard from writer 2
+    assert tr.frontier() == {0: 2}  # writer 2 min is -1 -> not stable
+
+
+def test_frontier_stalls_on_stale_summary():
+    ft = FakeTime()
+    ev = EventLog(node="t")
+    tr = StabilityTracker(StubNode({0: 5}), ["a"], max_staleness=10.0,
+                          clock=ft.now, events=ev)
+    tr.note("a", {0: 5}, {})
+    assert tr.frontier() == {0: 5}
+    ft.t += 11.0  # summary ages out; a live fleet would have refreshed it
+    assert tr.stale_members() == ["a"]
+    assert tr.frontier() == {}
+    assert len(ev.find(event="stability_stalled")) == 1
+
+
+def test_note_merges_monotone_under_reordering():
+    ft = FakeTime()
+    tr = StabilityTracker(StubNode({0: 9, 1: 9}), ["a"], clock=ft.now)
+    tr.note("a", {0: 7, 1: 2}, {})
+    tr.note("a", {0: 3, 1: 5}, {})  # delayed older summary arrives late
+    # watermarks are monotone facts: pointwise max, never replacement
+    assert tr.observed()["a"]["vv"] == {0: 7, 1: 5}
+    assert tr.frontier() == {0: 7, 1: 5}
+
+
+def test_stale_watermark_only_under_collects():
+    # a frontier minted from old watermarks is <= the true stable
+    # frontier — staleness can delay GC but never collect a live op
+    ft = FakeTime()
+    node = StubNode({0: 100})
+    tr = StabilityTracker(node, ["a"], clock=ft.now)
+    tr.note("a", {0: 40}, {})  # old view; a is really at 100 by now
+    f = tr.frontier()
+    assert f == {0: 40}
+    assert all(s <= 100 for s in f.values())
+
+
+def test_chain_rule_stalls_incomparable_fold():
+    # a member's already-folded frontier is AHEAD of the candidate min:
+    # minting would create an incomparable frontier generation
+    assert stable_frontier_host(
+        [{0: 5}, {0: 3}], [{0: 4}, {}]) == {}
+    # dominating candidate passes
+    assert stable_frontier_host(
+        [{0: 5}, {0: 4}], [{0: 4}, {}]) == {0: 4}
+
+
+def test_mint_appends_audit_ledger():
+    ft = FakeTime()
+    tr = StabilityTracker(StubNode({0: 5}), ["a"], clock=ft.now)
+    assert tr.mint(step=1) == {}  # stalled: no summary yet
+    assert tr.ledger == []        # empty mints leave no ledger row
+    tr.note("a", {0: 4}, {})
+    f = tr.mint(step=2)
+    assert f == {0: 4}
+    [rec] = tr.ledger
+    assert rec["step"] == 2
+    assert rec["frontier"] == {0: 4}
+    assert rec["summaries"]["a"] == {0: 4}
+    assert tr.last_frontier == {0: 4}
+
+
+def test_lag_ops_counts_debt_above_frontier():
+    ft = FakeTime()
+    node = StubNode({0: 9, 1: 4})
+    tr = StabilityTracker(node, ["a"], clock=ft.now)
+    tr.note("a", {0: 5, 1: 4}, {})
+    tr.mint()
+    # local holds (9+1)+(4+1)=15 ops, frontier covers (5+1)+(4+1)=11
+    assert tr.lag_ops() == 4
+
+
+def test_summary_header_roundtrip():
+    raw = encode_summary(3, {0: 5, 7: 2}, {0: 1})
+    d = decode_summary(raw)
+    assert d == {"rid": 3, "vv": {0: 5, 7: 2}, "frontier": {0: 1}}
+    assert decode_summary(None) is None
+    assert decode_summary("not json{") is None
+    assert decode_summary('{"vv":{}}') is None  # missing rid
+
+
+# ------------------------------------------------------------------ session
+
+
+def test_token_mint_and_join_laws():
+    t = mint_token([(0, 3), (0, 7), (2, 1)])
+    assert t == {0: 7, 2: 1}
+    a, b = {0: 5, 1: 2}, {0: 3, 2: 9}
+    j = token_join(a, b)
+    assert j == {0: 5, 1: 2, 2: 9}
+    assert token_join(b, a) == j            # commutative
+    assert token_join(j, j) == j            # idempotent
+    assert vv_dominates(j, a) and vv_dominates(j, b)  # lub
+
+
+def test_vv_dominance():
+    assert vv_dominates({0: 5, 1: 2}, {0: 5})
+    assert not vv_dominates({0: 4}, {0: 5})
+    assert not vv_dominates({}, {0: 0})
+    assert vv_dominates({}, {})
+
+
+def test_token_header_roundtrip():
+    t = {0: 7, 3: 2}
+    assert decode_token(encode_token(t)) == t
+    assert decode_token(None) is None
+    assert decode_token("garbage{") is None
+    assert decode_token('[1,2]') is None  # JSON but not an object
+
+
+def test_wait_for_dominance_times_out_on_fake_clock():
+    ft = FakeTime()
+    node = StubNode({0: 2})
+    ok = wait_for_dominance(node, {0: 5}, timeout=0.5, poll=0.1,
+                            clock=ft.now, sleep=ft.sleep)
+    assert not ok
+    assert ft.t >= 0.5          # slept exactly up to the deadline
+    assert ft.sleeps == 5
+
+
+def test_wait_for_dominance_proxy_fills_gap():
+    ft = FakeTime()
+    node = StubNode({0: 2})
+
+    def proxy():
+        node.vv[0] = 9  # the pulled delta lands
+
+    ok = wait_for_dominance(node, {0: 5}, timeout=0.5, poll=0.1,
+                            clock=ft.now, sleep=ft.sleep, proxy=proxy)
+    assert ok
+    assert ft.sleeps == 0  # proxied on the first round, never slept
+
+
+def test_session_read_your_writes_via_proxy():
+    a, b = mk_node(0), mk_node(1)
+    idents = a.add_commands([{"k": "v1"}])
+    token = mint_token(idents)
+    ft = FakeTime()
+    plane = mk_plane(b, [FakePeer(a, "a")], ft)
+    # b has never gossiped with a; the session read must proxy-pull
+    assert plane.read("k", level="session", token=token) == "v1"
+    assert b.metrics._counts.get("reads_session") == 1
+
+
+def test_session_token_timeout_503():
+    a, b = mk_node(0), mk_node(1)
+    token = mint_token(a.add_commands([{"k": "v1"}]))
+    ft = FakeTime()
+    plane = mk_plane(b, [], ft)  # nobody to proxy from
+    with pytest.raises(ConsistencyUnavailable) as ei:
+        plane.read("k", level="session", token=token)
+    assert ei.value.reason == "token_timeout"
+    assert ei.value.level == "session"
+    [rec] = b.events.find(event="consistency_unavailable")
+    assert rec["reason"] == "token_timeout"
+    assert b.metrics._counts.get("consistency_unavailable") == 1
+
+
+def test_read_your_writes_through_ingest_lane():
+    # the real ticket path: ingest front door mints the ident the
+    # session token is built from (http_shim POST /data does exactly this)
+    a, b = mk_node(0), mk_node(1)
+    door = IngestFrontDoor(a, max_batch=4, flush_deadline_s=0.001)
+    ident = door.admit_kv({"k": "from-lane"}, timeout=5.0)
+    assert ident is not None
+    token = mint_token([ident])
+    ft = FakeTime()
+    plane = mk_plane(b, [FakePeer(a, "a")], ft)
+    assert plane.read("k", level="session", token=token) == "from-lane"
+
+
+def test_session_read_requires_token():
+    ft = FakeTime()
+    plane = mk_plane(mk_node(0), [], ft)
+    with pytest.raises(ValueError):
+        plane.read("k", level="session")
+
+
+def test_unknown_level_rejected():
+    ft = FakeTime()
+    plane = mk_plane(mk_node(0), [], ft)
+    with pytest.raises(ValueError):
+        plane.read("k", level="strong")
+
+
+# ------------------------------------------------------------- linearizable
+
+
+def test_eventual_read_is_local_and_cheap():
+    n = mk_node(0)
+    n.add_commands([{"k": "v"}])
+    ft = FakeTime()
+    peer = FakePeer(mk_node(1), "p")
+    plane = mk_plane(n, [peer], ft)
+    assert plane.read("k") == "v"
+    assert plane.read("missing") is None  # absent key is a valid answer
+    assert peer.vv_calls == 0             # no quorum round paid
+
+
+def test_eventual_read_on_dead_node_503s():
+    n = mk_node(0)
+    n.set_alive(False)
+    ft = FakeTime()
+    plane = mk_plane(n, [], ft)
+    with pytest.raises(ConsistencyUnavailable) as ei:
+        plane.read("k")
+    assert ei.value.reason == "node_down"
+
+
+def test_linearizable_read_catches_up_to_quorum():
+    a, b, c = mk_node(0), mk_node(1), mk_node(2)
+    a.add_commands([{"k": "newest"}])
+    ft = FakeTime()
+    # b serves the read; a holds the op; c is behind like b
+    plane = mk_plane(b, [FakePeer(a, "a"), FakePeer(c, "c")], ft)
+    assert plane.read("k", level="linearizable") == "newest"
+    assert b.metrics._counts.get("reads_linearizable") == 1
+    h = b.metrics.registry.histogram("strong_read_quorum_seconds")
+    assert h is not None and h.count == 1
+
+
+def test_linearizable_quorum_loss_503_never_stale():
+    a, b, c = mk_node(0), mk_node(1), mk_node(2)
+    a.add_commands([{"k": "unreachable"}])
+    pa, pc = FakePeer(a, "a"), FakePeer(c, "c")
+    pa.down = pc.down = True
+    ft = FakeTime()
+    plane = mk_plane(b, [pa, pc], ft)
+    with pytest.raises(ConsistencyUnavailable) as ei:
+        plane.read("k", level="linearizable")
+    assert ei.value.reason == "quorum_lost"
+    assert ei.value.acks == 1 and ei.value.quorum == 2
+    assert not ei.value.indeterminate
+    [rec] = b.events.find(event="consistency_unavailable")
+    assert (rec["reason"], rec["acks"], rec["quorum"]) == ("quorum_lost", 1, 2)
+
+
+def test_open_breaker_counts_as_missing_ack():
+    a, b, c = mk_node(0), mk_node(1), mk_node(2)
+    pa, pc = FakePeer(a, "a"), FakePeer(c, "c")
+    pa.backed = pc.backed = True  # OPEN breakers: skipped, not timed out
+    ft = FakeTime()
+    plane = mk_plane(b, [pa, pc], ft)
+    with pytest.raises(ConsistencyUnavailable) as ei:
+        plane.read("k", level="linearizable")
+    assert ei.value.reason == "quorum_lost"
+    assert pa.vv_calls == 0 and pc.vv_calls == 0  # no paid timeouts
+
+
+def test_linearizable_catchup_timeout():
+    a, b = mk_node(0), mk_node(1)
+    a.add_commands([{"k": "v"}])
+    pa = FakePeer(a, "a")
+    pa.serve_deltas = False  # acks the vv probe but never serves the delta
+    ft = FakeTime()
+    plane = mk_plane(b, [pa], ft, strong_timeout=0.1, poll=0.02)
+    with pytest.raises(ConsistencyUnavailable) as ei:
+        plane.read("k", level="linearizable")
+    assert ei.value.reason == "catchup_timeout"
+    assert ft.t >= 0.1  # burned the whole (fake) deadline, then failed loud
+
+
+def test_quorum_override_self_sufficient():
+    n = mk_node(0)
+    n.add_commands([{"k": "v"}])
+    ft = FakeTime()
+    plane = mk_plane(n, [], ft, quorum=1)  # explicit quorum of one
+    assert plane.read("k", level="linearizable") == "v"
+
+
+# -------------------------------------------------------------------- cas
+
+
+def test_cas_matrix():
+    a, b = mk_node(0), mk_node(1)
+    ft = FakeTime()
+    plane = mk_plane(a, [FakePeer(b, "b")], ft)
+    # absent + expect None -> applied; returned token covers the write
+    token = plane.cas("k", None, "v1")
+    assert vv_dominates(a.version_vector(), token)
+    assert plane.read("k") == "v1"
+    # present + expect None -> conflict carrying the actual value
+    with pytest.raises(CasConflict) as ei:
+        plane.cas("k", None, "v2")
+    assert ei.value.actual == "v1"
+    # wrong expectation -> conflict
+    with pytest.raises(CasConflict):
+        plane.cas("k", "nope", "v2")
+    # matching expectation -> applied
+    plane.cas("k", "v1", "v2")
+    assert plane.read("k") == "v2"
+    assert b.get_state().get("k") == "v2"  # write quorum really pushed
+    assert a.metrics._counts.get("cas_applied") == 2
+    assert a.metrics._counts.get("cas_conflicts") == 2
+
+
+def test_cas_sees_remote_write_before_deciding():
+    # the linearizable read half of CAS: b's newer value must be pulled
+    # in before the expectation is evaluated, even though a never gossiped
+    a, b = mk_node(0), mk_node(1)
+    b.add_commands([{"k": "remote"}])
+    ft = FakeTime()
+    plane = mk_plane(a, [FakePeer(b, "b")], ft)
+    with pytest.raises(CasConflict) as ei:
+        plane.cas("k", None, "v")
+    assert ei.value.actual == "remote"
+
+
+def test_cas_quorum_lost_before_mint_is_clean():
+    a, b = mk_node(0), mk_node(1)
+    pb = FakePeer(b, "b")
+    pb.down = True
+    ft = FakeTime()
+    plane = mk_plane(a, [pb], ft)
+    with pytest.raises(ConsistencyUnavailable) as ei:
+        plane.cas("k", None, "v1")
+    assert ei.value.reason == "quorum_lost"
+    assert not ei.value.indeterminate  # nothing was minted
+    assert a.get_state().get("k") is None
+
+
+def test_cas_indeterminate_when_write_quorum_lost():
+    a, b = mk_node(0), mk_node(1)
+    pb = FakePeer(b, "b")
+    pb.accept_push = False  # read quorum fine; synchronous push dropped
+    ft = FakeTime()
+    plane = mk_plane(a, [pb], ft)
+    with pytest.raises(ConsistencyUnavailable) as ei:
+        plane.cas("k", None, "v1")
+    assert ei.value.reason == "write_quorum_lost"
+    assert ei.value.indeterminate           # minted locally, outcome unknown
+    assert a.get_state().get("k") == "v1"   # the op exists and will gossip
+    [rec] = a.events.find(event="consistency_unavailable")
+    assert rec["indeterminate"] is True
+
+
+def test_cas_proxy_quarantines_corrupt_payload():
+    # a corrupted proxied payload is skipped + logged with the SAME event
+    # the pull loop uses, so the nemesis corruption audit stays 1:1
+    a, b = mk_node(0), mk_node(1)
+    b.add_commands([{"k": "v"}])
+
+    class CorruptPeer(FakePeer):
+        def gossip_payload(self, since=None):
+            p = dict(super().gossip_payload(since=since) or {})
+            p["nemesis:corrupt:key"] = {"Key": "x", "Value": "y"}
+            return p
+
+    ft = FakeTime()
+    plane = mk_plane(a, [CorruptPeer(b, "b")], ft, strong_timeout=0.1)
+    with pytest.raises(ConsistencyUnavailable):
+        plane.read("k", level="linearizable")
+    assert a.events.find(event="payload_quarantine")
+    assert a.metrics._counts.get("consistency_proxy_quarantine", 0) >= 1

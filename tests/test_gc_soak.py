@@ -109,3 +109,89 @@ def test_map_gc_join_laws():
     eq(j(j(a, b), c), j(a, j(b, c)))        # associative
     eq(j(a, a), a)                          # idempotent
     eq(j(j(a, b), b), j(a, b))              # absorption
+
+
+# ---- fleet-coordinated GC: StabilityTracker-driven op-log compaction ----
+# (the nemesis --gc soak audits this same path under partitions/crashes;
+# here the coordination protocol itself is pinned deterministically)
+
+
+def _full_exchange(nodes):
+    for dst in nodes:
+        for src in nodes:
+            if src is not dst:
+                dst.receive(src.gossip_payload(since=dst.version_vector()))
+
+
+def _fleet_with_trackers(clock):
+    from crdt_tpu.api.node import ReplicaNode
+    from crdt_tpu.consistency import StabilityTracker
+
+    nodes = [ReplicaNode(rid=i, capacity=64) for i in range(3)]
+    labels = [f"n{i}" for i in range(3)]
+    trackers = [
+        StabilityTracker(n, [m for j, m in enumerate(labels) if j != i],
+                         clock=clock, events=n.events)
+        for i, n in enumerate(nodes)
+    ]
+    return nodes, labels, trackers
+
+
+def test_fleet_coordinated_gc_compacts_stable_prefix():
+    from crdt_tpu.api.node import ReplicaNode
+    from crdt_tpu.consistency import decode_summary, encode_summary
+
+    nodes, labels, trackers = _fleet_with_trackers(lambda: 0.0)
+    for i, n in enumerate(nodes):
+        n.add_commands([{f"k{i}-{j}": f"v{j}"} for j in range(5)])
+    _full_exchange(nodes)
+    before = [n.get_state() for n in nodes]
+    assert before[0] == before[1] == before[2]
+
+    # feed every tracker through the real header encoding (what the
+    # transport captures off GET /gossip responses)
+    for i, tr in enumerate(trackers):
+        for j, src in enumerate(nodes):
+            if j == i:
+                continue
+            vv, frontier = src.vv_snapshot()
+            s = decode_summary(encode_summary(src.rid, vv, frontier))
+            tr.note(labels[j], s["vv"], s["frontier"])
+
+    fronts = [tr.mint(step=1) for tr in trackers]
+    # fully exchanged fleet: every tracker proves the same full frontier
+    assert fronts[0] == fronts[1] == fronts[2]
+    assert fronts[0] == nodes[0].version_vector()
+
+    for n, f in zip(nodes, fronts):
+        n.compact(f)
+    for n, s in zip(nodes, before):
+        assert n.get_state() == s                 # fold is transparent
+        assert n.version_vector() == fronts[0]    # watermark preserved
+        assert len(n._commands) == 0              # raw rows reclaimed
+        assert n.metrics._counts.get("gc_reclaimed_ops", 0) == 15
+    assert all(tr.ledger[-1]["frontier"] == fronts[0] for tr in trackers)
+
+    # post-GC nodes still serve joinable payloads (summary sections)
+    late = ReplicaNode(rid=9, capacity=64)
+    late.receive(nodes[0].gossip_payload(since=late.version_vector()))
+    assert late.get_state() == before[0]
+
+
+def test_fleet_gc_stalls_on_silent_member():
+    nodes, labels, trackers = _fleet_with_trackers(lambda: 0.0)
+    for i, n in enumerate(nodes):
+        n.add_commands([{f"k{i}": "v"}])
+    _full_exchange(nodes)
+
+    # tracker 0 hears from n1 but NEVER from n2 (partitioned member)
+    vv, frontier = nodes[1].vv_snapshot()
+    trackers[0].note(labels[1], vv, frontier)
+    assert trackers[0].stale_members() == [labels[2]]
+    assert trackers[0].mint(step=1) == {}
+    assert trackers[0].ledger == []
+    assert nodes[0].events.find(event="stability_stalled")
+
+    # nothing was collected: the full raw history is still servable
+    assert len(nodes[0]._commands) == 3
+    assert nodes[0].metrics._counts.get("gc_reclaimed_ops", 0) == 0
