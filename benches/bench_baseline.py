@@ -547,6 +547,35 @@ def bench_orset_1m(results, tiny):
           traffic_kind="compute", dispatches=stripes)
 
 
+def bench_orset_engines(results, tiny):
+    """Three-arm set-union engine A/B (sort vs bucket vs bitmap) at one
+    shape, arms INTERLEAVED and the bit-equality gate asserted per rep
+    (standalone driver: benches/bench_orset.py --three-arm; engines:
+    crdt_tpu/ops/union_engine.py).  Off-TPU the parity gate still runs —
+    the rate rows need the chip."""
+    import argparse as _argparse
+
+    from benches import bench_orset as bo
+
+    c = 64 if tiny else 1024
+    ln = 128 if tiny else 1 << 17
+    ns = _argparse.Namespace(tiny=tiny, capacity=c, lanes=ln, bank=2, k=8,
+                             buckets=None, space=None, interpret=False)
+    pers = bo.run_three_arm(ns)
+    if pers is None:
+        _emit(results, "orset_engine_ab_smoke", 1, "ok",
+              "three-arm parity gate bit-identical (interpret mode, no TPU)")
+        return
+    base = pers["sort"]
+    for name, per in pers.items():
+        _emit(results, f"orset_union_{name}_unions_per_sec", ln / per,
+              "replica-unions/s",
+              f"engine arm '{name}' C={c} x {ln} lanes, interleaved A/B, "
+              f"bit parity per rep, x{base / per:.2f} vs sort",
+              bytes_per_step=6 * c * ln * 4, sec_per_step=per,
+              traffic_kind="compute")
+
+
 def bench_gossip_allreduce(results, tiny):
     """10K-replica swarm convergence: one step = tree-reduced join fixpoint +
     broadcast (what the reference needs many 1500 ms gossip rounds for)."""
@@ -624,6 +653,7 @@ ALL = {
     "orset_union": bench_orset_union,
     "orset_sweep": bench_orset_sweep,
     "orset_1m": bench_orset_1m,
+    "orset_engines": bench_orset_engines,
     "stripe_pipeline": bench_stripe_pipeline,
     "rseq_striped": bench_rseq_striped,
     "gossip_allreduce": bench_gossip_allreduce,

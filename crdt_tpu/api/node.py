@@ -28,6 +28,7 @@ import numpy as np
 
 from crdt_tpu.models import compactlog, oplog
 from crdt_tpu.obs import devtime, health
+from crdt_tpu.ops import union_engine
 from crdt_tpu.obs.events import EventLog
 from crdt_tpu.obs.provenance import FlightRecorder
 from crdt_tpu.obs.trace import current_trace, span
@@ -1164,6 +1165,11 @@ class ReplicaNode:
         # donated: it is rebound right below under the node lock, so XLA
         # may write the union into its buffers (TPU/GPU; plain jit on CPU).
         self.metrics.inc("merge_dispatches")
+        # the op-log merge is a sorted union — record which set-union
+        # engine served it (always "sort": the log's lex keys carry no
+        # packed single-word form) so the union_path counter on /metrics
+        # reflects EVERY set-union the node runs, not just ORSet joins
+        union_engine.record_union_path("sort")
         batch = oplog.from_ops(batch_cap, ops)
         timing = self.recorder.enabled
         t0 = time.perf_counter() if timing else 0.0

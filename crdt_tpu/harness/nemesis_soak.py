@@ -447,6 +447,12 @@ class NemesisSoak:
         # (key/value are derived from (rid, seq), so the ledger IS the
         # prefix oracle)
         self.writes: Dict[int, int] = {}
+        # strict-join gate baseline: the truncation tally is process-global
+        # (other tests in the same process deliberately trigger refusals),
+        # so the zero-truncations assertion is on the DELTA over this run
+        from crdt_tpu.ops import union_engine
+
+        self._truncations_at_start = union_engine.truncation_count()
         self.report = NemesisReport(seed=seed, steps=steps, nodes=nodes)
 
     # ---- step-phase actions (all rng-scheduled, all deterministic) ----
@@ -1213,9 +1219,34 @@ class NemesisSoak:
         self.report.propagation = propagation_summary(
             *(s.host.node.metrics.registry for s in self.slots)
         )
+        self._check_union_engine_health()
         if self.assemble_check:
             self._check_assembly()
         return self.report
+
+    def _check_union_engine_health(self) -> None:
+        """Set-union engine gates, ridden by EVERY soak: (1) the strict
+        join layer saw ZERO capacity truncations over the whole faulted
+        run (strict joins refuse loudly; a silent drop is a lost-write
+        bug); (2) the engine-dispatch counter is live on a served
+        /metrics scrape — auto-dispatch must stay observable, not
+        inferred from timings."""
+        import urllib.request
+
+        from crdt_tpu.ops import union_engine
+
+        delta = union_engine.truncation_count() - self._truncations_at_start
+        assert delta == 0, (
+            f"{delta} set-union truncation(s) recorded during the soak; "
+            "strict joins must refuse, never drop"
+        )
+        slot = next(s for s in self.slots if s.alive)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{slot.port}/metrics", timeout=10) as res:
+            body = res.read().decode()
+        assert "crdt_union_path_total" in body, (
+            "crdt_union_path_total missing from the served /metrics scrape"
+        )
 
     def _check_assembly(self, min_coverage: float = 0.95) -> None:
         """The flight-recorder CI gate: assemble the fleet's JSONL logs

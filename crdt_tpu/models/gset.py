@@ -87,6 +87,44 @@ def g_join_checked(a: GSet, b: GSet):
     return GSet(elem=keys[0]), n
 
 
+def g_join_strict(a: GSet, b: GSet) -> GSet:
+    """Host-level join refusing capacity overflow: raises
+    :class:`crdt_tpu.ops.union_engine.UnionOverflow` instead of silently
+    dropping the largest elements (grow-only means a drop un-adds forever).
+    Records the refusal on the truncation tally."""
+    from crdt_tpu.ops import union_engine
+
+    out, n_unique = g_join_checked(a, b)
+    n = int(n_unique)
+    if n > a.capacity:
+        union_engine.record_truncation()
+        raise union_engine.UnionOverflow(
+            f"G-Set join needs {n} rows > capacity {a.capacity}"
+        )
+    return out
+
+
+def g_join_auto(a: GSet, b: GSet, universe=None, registry=None) -> GSet:
+    """Host-level join through the union-engine auto-dispatch: a declared
+    dense element universe rides the bitmap fast path (elements ARE keys
+    here — no packing needed), everything else the proven sort path; the
+    chosen path lands on the ``union_path`` tally either way."""
+    from crdt_tpu.ops import union_engine
+
+    plan = union_engine.plan_union(a.capacity, universe=universe)
+    union_engine.record_union_path(plan.path, registry=registry)
+    if plan.path == "bitmap":
+        pa, _ = union_engine.sorted_to_bitmap(
+            a.elem[:, None], jnp.zeros_like(a.elem)[:, None], universe)
+        pb, _ = union_engine.sorted_to_bitmap(
+            b.elem[:, None], jnp.zeros_like(b.elem)[:, None], universe)
+        keys, _, _ = union_engine.bitmap_to_sorted(
+            pa | pb, jnp.zeros_like(pa), a.capacity)
+        return GSet(elem=keys[:, 0])
+    out, _ = g_join_checked(a, b)
+    return out
+
+
 def g_contains(s: GSet, elem) -> jax.Array:
     return jnp.any(s.elem == jnp.asarray(elem, jnp.int32))
 
@@ -131,6 +169,20 @@ def tp_join_checked(a: TwoPSet, b: TwoPSet):
         out_size=a.capacity,
     )
     return TwoPSet(elem=keys[0], removed=vals["removed"]), n
+
+
+def tp_join_strict(a: TwoPSet, b: TwoPSet) -> TwoPSet:
+    """Host-level join refusing capacity overflow (see g_join_strict)."""
+    from crdt_tpu.ops import union_engine
+
+    out, n_unique = tp_join_checked(a, b)
+    n = int(n_unique)
+    if n > a.capacity:
+        union_engine.record_truncation()
+        raise union_engine.UnionOverflow(
+            f"2P-Set join needs {n} rows > capacity {a.capacity}"
+        )
+    return out
 
 
 def tp_contains(s: TwoPSet, elem) -> jax.Array:

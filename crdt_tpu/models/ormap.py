@@ -117,3 +117,21 @@ def joiner(value_join_batched: Callable) -> Callable:
     """A two-argument ORMap join closure (for swarm/mesh engines that take
     ``join(a, b)``)."""
     return lambda a, b: join(a, b, value_join_batched)
+
+
+def joiner_recorded(value_join_batched: Callable, path: str = "sort",
+                    registry=None) -> Callable:
+    """Like :func:`joiner`, but each HOST-LEVEL call lands on the
+    ``union_path`` tally (crdt_tpu.ops.union_engine) so map joins show up
+    in the /metrics ``union_path{path=...}`` counter alongside the set
+    engines.  The presence plane is a max-lattice (no set union), so the
+    recorded path describes the VALUE join's engine — "sort" unless the
+    caller routes values through a restructured layout.  Only hand this to
+    host-side drive loops; under jit the record would count traces."""
+    from crdt_tpu.ops import union_engine
+
+    def _join(a, b):
+        union_engine.record_union_path(path, registry=registry)
+        return join(a, b, value_join_batched)
+
+    return _join

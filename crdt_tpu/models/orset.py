@@ -95,6 +95,25 @@ def join_checked(a: ORSet, b: ORSet):
     return ORSet(elem=keys[0], rid=keys[1], seq=keys[2], removed=removed), n_unique
 
 
+def join_strict(a: ORSet, b: ORSet) -> ORSet:
+    """Host-level join that REFUSES capacity overflow: raises
+    :class:`crdt_tpu.ops.union_engine.UnionOverflow` instead of silently
+    dropping the largest tags (which would permanently lose adds and break
+    per-writer seq contiguity).  Records the refusal on the truncation
+    tally so soaks can assert zero truncations happened."""
+    from crdt_tpu.ops import union_engine
+
+    out, n_unique = join_checked(a, b)
+    n = int(n_unique)
+    if n > a.capacity:
+        union_engine.record_truncation()
+        raise union_engine.UnionOverflow(
+            f"OR-Set join needs {n} rows > capacity {a.capacity}; "
+            "grow() both replicas before joining"
+        )
+    return out
+
+
 def contains(s: ORSet, elem) -> jax.Array:
     hit = (s.elem == jnp.asarray(elem, jnp.int32)) & (s.elem != SENTINEL)
     return jnp.any(hit & ~s.removed)
@@ -198,46 +217,212 @@ def stack_to_columnar(sets):
     # single instance -> one lane; batched [R, C] -> R lanes
     elem, rid, seq, removed = map(jnp.atleast_2d, (elem, rid, seq, removed))
     valid = elem != SENTINEL
-    # host-side staging: verify the tag space fits the packed bit budget —
-    # out-of-budget fields would bleed across bit boundaries and silently
-    # corrupt the join's sort order
-    ev, rv, sv = (np.asarray(jnp.where(valid, x, 0)) for x in (elem, rid, seq))
-    pack.check_budget(
-        int(ev.max(initial=0)) + 1, int(rv.max(initial=0)) + 1, int(sv.max(initial=0)) + 1
-    )
-    packed = jnp.where(valid, pack.pack_tags(elem, rid, seq), SENTINEL)
+    # host-side staging: pack_tags_checked raises when any valid row's
+    # field exceeds its bit budget — out-of-budget fields would bleed
+    # across bit boundaries and silently corrupt the join's sort order
+    del np
+    packed_all = pack.pack_tags_checked(elem, rid, seq, valid=valid)
+    packed = jnp.where(valid, packed_all, SENTINEL)
     return packed.T, jnp.where(valid, removed, False).astype(jnp.int32).T
 
 
 def columnar_join(packed_a, removed_a, packed_b, removed_b, out_size=None,
-                  interpret: bool = False):
-    """Swarm-wide OR-Set join in the columnar layout: one Pallas bitonic
-    merge + fused tombstone-OR dedupe.  Returns (packed, removed, n_unique);
-    n_unique[j] > out_size means lane j overflowed (largest tags dropped).
+                  interpret: bool = False, engine: str = "sort",
+                  universe=None, registry=None):
+    """Swarm-wide OR-Set join in the columnar layout.  Returns
+    (packed, removed, n_unique); n_unique[j] > out_size means lane j
+    overflowed (largest tags dropped).
+
+    ``engine`` selects the set-union engine ("sort" — the Pallas bitonic
+    merge + fused tombstone-OR dedupe, the proven default — "bucket",
+    "bitmap", or "auto" for the capacity/density heuristic; see
+    crdt_tpu.ops.union_engine).  Every call records its path on the
+    ``union_path`` tally (and directly on ``registry`` when given).  All
+    engines are bit-identical at this boundary — the restructured layouts
+    win by staying RESIDENT (ORSetBucketed / ORSetBitmap), not here.
 
     Lane counts that aren't a multiple of the kernel's 128-lane tile are
-    padded with empty columns here and sliced back off the outputs."""
-    from crdt_tpu.ops import pallas_union
+    padded with empty columns inside the dispatcher (only on the Pallas
+    paths that need tile alignment) and sliced back off the outputs."""
+    from crdt_tpu.ops import union_engine
 
     out = out_size if out_size is not None else packed_a.shape[0]
-    lanes = packed_a.shape[1]
-    pad = (-lanes) % pallas_union.LANES
-    if pad:
-        def padk(k):
-            return jnp.pad(k, ((0, 0), (0, pad)), constant_values=int(SENTINEL))
-
-        def padv(v):
-            return jnp.pad(v, ((0, 0), (0, pad)))
-
-        packed_a, packed_b = padk(packed_a), padk(packed_b)
-        removed_a, removed_b = padv(removed_a), padv(removed_b)
-    keys, vals, n = pallas_union.sorted_union_columnar(
-        packed_a, removed_a, packed_b, removed_b,
-        out_size=out, interpret=interpret,
+    keys, vals, n, _path = union_engine.dispatch_union(
+        packed_a, removed_a, packed_b, removed_b, out,
+        engine=engine, universe=universe, interpret=interpret,
+        registry=registry,
     )
-    if pad:
-        keys, vals, n = keys[:, :lanes], vals[:, :lanes], n[:lanes]
     return keys, vals, n
+
+
+# ---- resident restructured layouts (crdt_tpu.ops.union_engine) ----
+#
+# The bucketed/bitmap engines pay layout-conversion costs at the sorted-
+# columnar boundary; a swarm that STAYS in the restructured layout across
+# chained joins keeps only the cheap part.  These structs are the resident
+# forms: single-instance (1-D planes) for the lattice-law registry, with
+# the swarm layout just the same planes with a lane axis.
+
+
+@struct.dataclass
+class ORSetBitmap:
+    """Dense-universe OR-Set: packed-tag universe as two int32 bit planes
+    (``present`` / ``removed``, tag t ↔ bit t%32 of word t//32).  join =
+    elementwise OR of both planes — associative/commutative/idempotent BY
+    STRUCTURE, and pure HBM streaming on chip."""
+
+    present: jax.Array  # int32[W] (or int32[W, R] for a swarm)
+    removed: jax.Array  # int32[W]
+
+    @property
+    def universe(self) -> int:
+        return self.present.shape[0] * 32
+
+
+def bitmap_empty(universe: int) -> ORSetBitmap:
+    from crdt_tpu.ops import union_engine
+
+    w = union_engine.bitmap_words(universe)
+    z = jnp.zeros((w,), jnp.int32)
+    return ORSetBitmap(present=z, removed=z)
+
+
+def bitmap_join(a: ORSetBitmap, b: ORSetBitmap) -> ORSetBitmap:
+    return ORSetBitmap(present=a.present | b.present,
+                       removed=a.removed | b.removed)
+
+
+def bitmap_size(s: ORSetBitmap) -> jax.Array:
+    """Observed tag count (live + tombstoned): popcount of ``present``."""
+    return jnp.sum(jax.lax.population_count(s.present)).astype(jnp.int32)
+
+
+def to_bitmap(s: ORSet, universe: int) -> ORSetBitmap:
+    """ORSet → bitmap layout.  Packed tags must be < ``universe`` — the
+    caller declares the dense tag space (host-checked)."""
+    from crdt_tpu.ops import pack, union_engine
+
+    valid = s.elem != SENTINEL
+    packed_all = pack.pack_tags_checked(s.elem, s.rid, s.seq, valid=valid)
+    packed = jnp.where(valid, packed_all, SENTINEL)
+    import numpy as np
+
+    live = np.asarray(packed[np.asarray(valid)])
+    if live.size and int(live.max()) >= universe:
+        raise ValueError(
+            f"packed tag {int(live.max())} >= declared universe {universe}")
+    # the bit-plane scatter needs no sorted order — rows land by key value
+    p, r = union_engine.sorted_to_bitmap(
+        packed[:, None],
+        jnp.where(valid, s.removed, False).astype(jnp.int32)[:, None],
+        universe)
+    return ORSetBitmap(present=p[:, 0], removed=r[:, 0])
+
+
+def from_bitmap(s: ORSetBitmap, capacity: int) -> ORSet:
+    """Bitmap layout → canonical ORSet (tags unpacked, sorted, padded)."""
+    from crdt_tpu.ops import pack, union_engine
+
+    keys, vals, _ = union_engine.bitmap_to_sorted(
+        s.present[:, None], s.removed[:, None], capacity)
+    keys, vals = keys[:, 0], vals[:, 0]
+    valid = keys != SENTINEL
+    elem, rid, seq = pack.unpack_tags(jnp.where(valid, keys, 0))
+    pad = jnp.int32(SENTINEL)
+    return ORSet(elem=jnp.where(valid, elem, pad),
+                 rid=jnp.where(valid, rid, pad),
+                 seq=jnp.where(valid, seq, pad),
+                 removed=jnp.where(valid, vals != 0, False))
+
+
+@struct.dataclass
+class ORSetBucketed:
+    """Bucket-resident OR-Set: packed tags range-partitioned into
+    ``n_buckets`` segments of C/n_buckets rows (bucket = key >> shift),
+    each segment sorted ascending with its own SENTINEL tail.  join =
+    bucket-local short merge networks (crdt_tpu.ops.pallas_union.
+    bucketed_union_columnar) — log2(2·Wb) stages instead of log2(2·C).
+
+    Capacity contract: each BUCKET holds at most Wb tags; a join whose
+    true per-bucket union exceeds Wb drops that bucket's largest keys
+    (detectable via ``bucketed_join_checked``)."""
+
+    keys: jax.Array     # int32[C]  packed tags in bucketed layout
+    removed: jax.Array  # int32[C]
+    n_buckets: int = struct.field(pytree_node=False)
+    key_bits: int = struct.field(pytree_node=False, default=31)
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+
+def bucketed_empty(capacity: int, n_buckets: int,
+                   key_bits: int = 31) -> ORSetBucketed:
+    return ORSetBucketed(
+        keys=jnp.full((capacity,), SENTINEL, jnp.int32),
+        removed=jnp.zeros((capacity,), jnp.int32),
+        n_buckets=n_buckets, key_bits=key_bits)
+
+
+def bucketed_join(a: ORSetBucketed, b: ORSetBucketed) -> ORSetBucketed:
+    out, _ = bucketed_join_checked(a, b)
+    return out
+
+
+def bucketed_join_checked(a: ORSetBucketed, b: ORSetBucketed):
+    """Returns (joined, bucket_max): ``bucket_max`` is the fullest
+    bucket's pre-truncation unique count — > Wb means that bucket
+    overflowed and dropped its largest tags."""
+    from crdt_tpu.ops import pallas_union
+
+    assert a.n_buckets == b.n_buckets and a.capacity == b.capacity
+    ko, vo, _, bmax = pallas_union.bucketed_union_columnar_xla(
+        a.keys[:, None], a.removed[:, None],
+        b.keys[:, None], b.removed[:, None], n_buckets=a.n_buckets)
+    return ORSetBucketed(keys=ko[:, 0], removed=vo[:, 0],
+                         n_buckets=a.n_buckets,
+                         key_bits=a.key_bits), bmax[0]
+
+
+def to_bucketed(s: ORSet, n_buckets: int,
+                key_bits: int = 31) -> ORSetBucketed:
+    """ORSet → bucket-resident layout.  Raises UnionOverflow when a
+    bucket cannot hold its share of tags (the layout would drop rows) —
+    the auto-dispatch falls back to the sort path in that case."""
+    from crdt_tpu.ops import pack, union_engine
+
+    valid = s.elem != SENTINEL
+    packed_all = pack.pack_tags_checked(s.elem, s.rid, s.seq, valid=valid)
+    packed = jnp.where(valid, packed_all, SENTINEL)
+    order = jnp.argsort(packed)
+    keys, vals, dropped = union_engine.sorted_to_bucketed(
+        packed[order][:, None],
+        jnp.where(valid, s.removed, False)[order][:, None].astype(jnp.int32),
+        n_buckets, key_bits)
+    if int(dropped[0]) != 0:
+        union_engine.record_truncation()
+        raise union_engine.UnionOverflow(
+            f"{int(dropped[0])} tags overflow their bucket "
+            f"(capacity {s.capacity} / {n_buckets} buckets)")
+    return ORSetBucketed(keys=keys[:, 0], removed=vals[:, 0],
+                         n_buckets=n_buckets, key_bits=key_bits)
+
+
+def from_bucketed(s: ORSetBucketed) -> ORSet:
+    """Bucket-resident layout → canonical ORSet (same capacity)."""
+    from crdt_tpu.ops import pack, union_engine
+
+    keys, vals, _ = union_engine.bucketed_to_sorted(
+        s.keys[:, None], s.removed[:, None])
+    keys, vals = keys[:, 0], vals[:, 0]
+    valid = keys != SENTINEL
+    elem, rid, seq = pack.unpack_tags(jnp.where(valid, keys, 0))
+    pad = jnp.int32(SENTINEL)
+    return ORSet(elem=jnp.where(valid, elem, pad),
+                 rid=jnp.where(valid, rid, pad),
+                 seq=jnp.where(valid, seq, pad),
+                 removed=jnp.where(valid, vals != 0, False))
 
 
 def columnar_member_mask(packed, removed, n_universe: int):

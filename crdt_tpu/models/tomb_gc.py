@@ -187,10 +187,18 @@ def join(a: Gc, b: Gc, adapter) -> Gc:
     which breaks the per-writer contiguity the floor-coverage proof rests
     on (silent permanent data loss).  The host-side n_unique check forces a
     device sync; throughput paths (vmapped barriers) use ``join_checked``
-    and batch the check like gc_round does."""
+    and batch the check like gc_round does.
+
+    GC joins are PINNED to the sort path (recorded on the union_path
+    tally): the src-marker suppression rule needs the full row union with
+    per-row provenance, which the bitmap/bucket layouts don't carry."""
+    from crdt_tpu.ops import union_engine
+
+    union_engine.record_union_path("sort")
     out, n_unique = join_checked(a, b, adapter)
     cap = adapter.capacity_of(a.inner)
     if int(n_unique) > cap:
+        union_engine.record_truncation()
         raise GcOverflow(
             f"GC join needs {int(n_unique)} rows but capacity is {cap}"
         )

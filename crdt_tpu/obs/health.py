@@ -210,10 +210,38 @@ def sample_race_watch(registry) -> None:
                            attr=attr)
 
 
+def sample_union_paths(registry) -> None:
+    """Delta-converge the process-global union-engine tallies
+    (crdt_tpu.ops.union_engine: which set-union engine served each join —
+    sort / bucket / bitmap — plus refused truncations) into THIS
+    registry's monotone counters.  The models record host-side into the
+    global tally because they have no registry handle; each registry
+    catches up at scrape time by inc'ing only the delta since its own
+    last sample, so ``crdt_union_path_total{path=...}`` stays monotone
+    per registry even with several nodes scraping the same process."""
+    from crdt_tpu.ops import union_engine
+
+    counts = union_engine.union_path_counts()
+    counts.setdefault("sort", 0)  # the series exists from the first scrape
+    for path, total in sorted(counts.items()):
+        registry.inc("union_path", 0, path=path)
+        seen = registry.gauge_value("union_path_sampled", path=path) or 0
+        if total > seen:
+            registry.inc("union_path", total - seen, path=path)
+            registry.set_gauge("union_path_sampled", total, path=path)
+    trunc = union_engine.truncation_count()
+    registry.inc("union_truncations_refused", 0)
+    seen = registry.gauge_value("union_truncations_sampled") or 0
+    if trunc > seen:
+        registry.inc("union_truncations_refused", trunc - seen)
+        registry.set_gauge("union_truncations_sampled", trunc)
+
+
 def sample_all(registry, node, set_node=None, seq_node=None,
                map_node=None, composite_node=None, agent=None,
                ingest=None, stability=None) -> None:
     sample_kv_node(registry, node)
+    sample_union_paths(registry)
     if set_node is not None:
         sample_set_node(registry, set_node)
     if seq_node is not None:

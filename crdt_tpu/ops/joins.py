@@ -356,6 +356,20 @@ def _register_builtin_joins() -> None:
                   neutral=lambda: orset.empty(16),
                   rand=rs.rand_orset,
                   small=lambda: rs.small_seeded(rs.rand_orset, fill=2))
+    # restructured set-union layouts (crdt_tpu.ops.union_engine): the
+    # bitmap join is plane-wise OR — ACI by structure — while the bucketed
+    # join runs the short bucket-local merge network; its generators keep
+    # per-bucket headroom so law-closure joins never truncate a bucket
+    register_join("orset_bitmap", orset.bitmap_join,
+                  neutral=lambda: orset.bitmap_empty(64),
+                  rand=rs.rand_orset_bitmap,
+                  small=rs.small_orset_bitmap,
+                  structurally_commutative=True)
+    register_join("orset_bucketed", orset.bucketed_join,
+                  neutral=lambda: orset.bucketed_empty(32, 4, key_bits=8),
+                  rand=rs.rand_orset_bucketed,
+                  small=lambda: rs.small_seeded(rs.rand_orset_bucketed,
+                                                fill=1))
     register_join("rseq", rseq.join,
                   neutral=lambda: rseq.empty(16),
                   rand=rs.rand_rseq,

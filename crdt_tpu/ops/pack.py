@@ -34,6 +34,36 @@ def unpack_tags(packed: jax.Array):
     return elem, rid, seq
 
 
+def pack_tags_checked(elem, rid, seq, valid=None):
+    """Host-side hardened :func:`pack_tags`: raises ValueError when any
+    VALID row exceeds a field's bit budget (or is negative).  Unchecked
+    packing silently corrupts keys — an over-budget elem bleeds into the
+    rid field, so two distinct tags can collide (and collided tags merge,
+    which is permanent data loss in a join).
+
+    ``valid`` masks out padding rows (SENTINEL-filled rows are all-ones
+    and would always trip the check); ``None`` checks every row.  This is
+    a HOST function — concrete arrays only, never call it under jit.
+    Returns the packed int32 array for the valid rows (padding rows pack
+    to whatever pack_tags yields — callers re-pad with SENTINEL)."""
+    import numpy as np
+
+    limits = (("elem", elem, ELEM_BITS), ("rid", rid, RID_BITS),
+              ("seq", seq, SEQ_BITS))
+    mask = None if valid is None else np.asarray(valid)
+    for name, col, bits in limits:
+        arr = np.asarray(col)
+        sel = arr if mask is None else arr[mask]
+        if sel.size and (sel.min() < 0 or sel.max() >= 1 << bits):
+            bad = int(sel.min()) if sel.min() < 0 else int(sel.max())
+            raise ValueError(
+                f"{name} value {bad} outside the {bits}-bit packed budget "
+                f"[0, {1 << bits}); packing would corrupt keys — widen the "
+                "budget split or use the generic sorted_union path"
+            )
+    return pack_tags(jnp.asarray(elem), jnp.asarray(rid), jnp.asarray(seq))
+
+
 def check_budget(n_elems: int, n_rids: int, n_seqs: int) -> None:
     if n_elems > 1 << ELEM_BITS or n_rids > 1 << RID_BITS or n_seqs > 1 << SEQ_BITS:
         raise ValueError(

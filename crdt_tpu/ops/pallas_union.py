@@ -42,17 +42,25 @@ from crdt_tpu.utils.constants import SENTINEL
 LANES = 128
 
 
-def _merge_stages_planes(planes, n, n_keys):
+def _merge_stages_planes(planes, n, n_keys, start_stride=None):
     """The bitonic-merge compare-exchange network, generic over row width:
     ``planes`` are (n, LANES) arrays whose columns are bitonic sequences
     (ascending A ++ descending B); the first ``n_keys`` planes form the
     lexicographic sort key and every plane swaps under the same mask;
     log2(n) stages at strides n/2..1 sort every column.  Shared by the
-    plain-merge, OR-combine fused, and lex2 keep-first fused kernels."""
-    stride = n // 2
+    plain-merge, OR-combine fused, and lex2 keep-first fused kernels.
+
+    ``start_stride`` < n/2 runs only the tail stages: because the reshape
+    to (n/(2·stride), 2, stride, LANES) partitions rows into consecutive
+    2·stride segments, stages at strides s..1 sort each 2s-row segment
+    INDEPENDENTLY — the bucketed union kernel exploits this to merge B
+    bucket-local bitonic segments of 2·Wb rows with log2(2·Wb) stages
+    instead of log2(n)."""
+    stride = start_stride if start_stride is not None else n // 2
+    w = planes[0].shape[1]
     while stride >= 1:
         nb = n // (2 * stride)
-        rs = [p.reshape(nb, 2, stride, LANES) for p in planes]
+        rs = [p.reshape(nb, 2, stride, w) for p in planes]
         side_lo = [r[:, 0] for r in rs]
         side_hi = [r[:, 1] for r in rs]
         swap = side_lo[0] > side_hi[0]
@@ -63,7 +71,7 @@ def _merge_stages_planes(planes, n, n_keys):
         planes = [
             jnp.stack(
                 [jnp.where(swap, h, l), jnp.where(swap, l, h)], axis=1
-            ).reshape(n, LANES)
+            ).reshape(n, w)
             for l, h in zip(side_lo, side_hi)
         ]
         stride //= 2
@@ -876,3 +884,230 @@ def sorted_union_columnar(
             keys_a, vals_a, keys_b, vals_b, out_size=out_size,
             interpret=interpret,
         )
+
+
+# ---- bucket-local union (the second set-union engine's kernel) --------------
+#
+# The floor analysis (benches/orset_floor.py, PERF.md) proved the fused
+# union kernel data-movement bound on its sublane shift passes: ~36 full
+# (2C, 128) plane passes at C=1024 (11 merge interleaves x 2 planes, 3
+# punch passes, 11 prefix shift-adds, 11 compaction passes x 2 planes).
+# Range-partitioning each lane's keys into B static buckets of Wb = C/B
+# rows makes every pass family BUCKET-LOCAL: log2(2·Wb) stages instead of
+# log2(2C) — at Wb=16 that is 5+3+5+2·5 = 23 short passes vs 36 full ones,
+# and the merge/prefix/compaction shifts move the same plane widths, so
+# the VPU *and* movement cost both drop by the stage-count ratio.  The
+# trade: a bucketed-resident state needs per-bucket capacity headroom
+# (a bucket CAN overflow while the table has global room — the dispatcher
+# falls back to the sort path when conversion detects that).
+#
+# Segment machinery: stages at strides Wb..1 come free from the existing
+# reshape network (start_stride — see _merge_stages_planes); the prefix
+# sum and compaction get segmented shift helpers that reshape to
+# (n_segments, seg, LANES) and shift within the middle axis, so a hole
+# never migrates across a bucket boundary.
+
+
+def _seg_shift_up(x, s, fill, seg):
+    """Segment-local _shift_up: x[b, i] := x[b, i+s] within each ``seg``-row
+    segment, tails filled — same slice+concat lowering, one reshape out."""
+    w = x.shape[1]
+    r = x.reshape(-1, seg, w)
+    out = jnp.concatenate(
+        [r[:, s:], jnp.full((r.shape[0], s, w), fill, x.dtype)], axis=1
+    )
+    return out.reshape(x.shape)
+
+
+def _seg_shift_down(x, s, fill, seg):
+    """Segment-local _shift_down: x[b, i] := x[b, i-s], heads filled."""
+    w = x.shape[1]
+    r = x.reshape(-1, seg, w)
+    out = jnp.concatenate(
+        [jnp.full((r.shape[0], s, w), fill, x.dtype), r[:, :-s]], axis=1
+    )
+    return out.reshape(x.shape)
+
+
+def _bucketed_union_body(keys, vals, n_buckets):
+    """The bucket-local union pipeline over interleaved (2C, LANES) planes
+    whose consecutive 2·Wb-row segments are bucket-local bitonic sequences
+    (bucket b's A rows ascending ++ its B rows pre-flipped descending).
+    Pure jnp — the SAME body runs inside the Pallas kernel and under plain
+    XLA (the CPU bench / single-lane model path), so the two callers
+    cannot drift apart.
+
+    Stages (mirroring _union_kernel, every pass segment-local):
+      1. merge: compare-exchange stages at strides Wb..1 (the reshape
+         network partitions segment-aligned, see _merge_stages_planes);
+      2. adjacent-dup punch with a GLOBAL one-row lookback — safe across
+         segment boundaries because real keys in different buckets differ
+         by construction and SENTINEL rows are masked out;
+      3. segmented Hillis-Steele prefix sum (log2(2·Wb) shift-adds);
+      4. segmented compaction with the FLAG_SHIFT disp-fold (disp < 2·Wb
+         per segment, far under the flag bit).
+
+    Returns (keys, vals, nu_seg) with nu_seg int32[B, LANES] = each
+    bucket's pre-truncation unique count."""
+    n = keys.shape[0]
+    seg = n // n_buckets          # = 2 * Wb
+    wb = seg // 2
+    keys, vals = _merge_stages_planes([keys, vals], n, n_keys=1,
+                                      start_stride=wb)
+
+    prev = _shift_down(keys, 1, SENTINEL)
+    dup = (keys == prev) & (keys != SENTINEL)
+    next_dup = _shift_up(dup.astype(jnp.int32), 1, 0) != 0
+    vals = jnp.where(next_dup, vals | _shift_up(vals, 1, 0), vals)
+    keys = jnp.where(dup, SENTINEL, keys)
+    vals = jnp.where(dup, 0, vals)
+
+    hole = keys == SENTINEL
+    p = hole.astype(jnp.int32)
+    s = 1
+    while s < seg:
+        p = p + _seg_shift_down(p, s, 0, seg)
+        s *= 2
+    disp = jnp.where(hole, 0, p - hole.astype(jnp.int32))
+    # each segment's last prefix row is its hole count
+    nu_seg = seg - p.reshape(n_buckets, seg, keys.shape[1])[:, seg - 1]
+    disp = disp | (vals << FLAG_SHIFT)
+
+    s = 1
+    while s < seg:
+        cand_k = _seg_shift_up(keys, s, SENTINEL, seg)
+        cand_d = _seg_shift_up(disp, s, 0, seg)
+        take = (cand_d & s) != 0
+        keep = (disp & s) == 0
+        keys = jnp.where(take, cand_k, jnp.where(keep, keys, SENTINEL))
+        disp = jnp.where(take, cand_d - s, jnp.where(keep, disp, 0))
+        s *= 2
+    return keys, disp >> FLAG_SHIFT, nu_seg
+
+
+def _interleave_buckets(ka, va, kbf, vbf, n_buckets):
+    """Stack bucket b's A segment (ascending) above its pre-flipped B
+    segment (descending): (C, LANES) x2 -> (2C, LANES) planes whose
+    consecutive 2·Wb segments are bitonic."""
+    c, w = ka.shape
+    wb = c // n_buckets
+
+    def inter(a, b):
+        ar = a.reshape(n_buckets, wb, w)
+        br = b.reshape(n_buckets, wb, w)
+        return jnp.concatenate([ar, br], axis=1).reshape(2 * c, w)
+
+    return inter(ka, kbf), inter(va, vbf)
+
+
+def _make_bucketed_union_kernel(n_buckets: int):
+    def kernel(ka_ref, va_ref, kbf_ref, vbf_ref, ko_ref, vo_ref,
+               nu_ref, nb_ref):
+        c = ka_ref.shape[0]
+        out_rows = ko_ref.shape[0] // n_buckets
+        keys, vals = _interleave_buckets(
+            ka_ref[:], va_ref[:], kbf_ref[:], vbf_ref[:], n_buckets
+        )
+        keys, vals, nu_seg = _bucketed_union_body(keys, vals, n_buckets)
+        nu_ref[:] = jnp.sum(nu_seg, axis=0, keepdims=True)
+        nb_ref[:] = jnp.max(nu_seg, axis=0, keepdims=True)
+        seg = 2 * c // n_buckets
+        ko_ref[:] = keys.reshape(n_buckets, seg, LANES)[:, :out_rows].reshape(
+            n_buckets * out_rows, LANES)
+        vo_ref[:] = vals.reshape(n_buckets, seg, LANES)[:, :out_rows].reshape(
+            n_buckets * out_rows, LANES)
+
+    return kernel
+
+
+def _flip_buckets(x, n_buckets):
+    """Per-segment descending flip of the B operand, in XLA (Mosaic has no
+    `rev`; same staging move as the full-width kernels' jnp.flip)."""
+    c = x.shape[0]
+    wb = c // n_buckets
+    return jnp.flip(x.reshape(n_buckets, wb, -1), axis=1).reshape(x.shape)
+
+
+def _bucketed_check(keys_a, n_buckets, out_bucket_rows):
+    c, lanes = keys_a.shape
+    wb = c // n_buckets
+    assert wb * n_buckets == c, f"{n_buckets} buckets must divide C={c}"
+    assert wb & (wb - 1) == 0, f"bucket width {wb} must be a power of two"
+    out_r = out_bucket_rows if out_bucket_rows is not None else wb
+    assert out_r <= 2 * wb, (
+        f"out_bucket_rows {out_r} exceeds the lossless 2·Wb={2*wb} bound")
+    return wb, out_r, lanes
+
+
+@partial(jax.jit,
+         static_argnames=("n_buckets", "out_bucket_rows", "interpret"))
+def bucketed_union_columnar(
+    keys_a: jax.Array,   # int32[C, L] BUCKETED layout (B segs of Wb rows,
+    vals_a: jax.Array,   #   each sorted asc w/ SENTINEL tail)
+    keys_b: jax.Array,
+    vals_b: jax.Array,
+    n_buckets: int,
+    out_bucket_rows: int | None = None,
+    interpret: bool = False,
+):
+    """Fused bucket-local columnar union: one pallas_call, one HBM round
+    trip, log2(2·Wb)-deep pass families (see _bucketed_union_body).  Both
+    operands and the output are in the bucketed layout; ``out_bucket_rows``
+    truncates each bucket's segment (default Wb — the steady-state
+    capacity; per-bucket overflow stays detectable via the returned max).
+
+    Returns (keys[B·out, L], vals[B·out, L], n_unique[L],
+    bucket_max[L]): n_unique is the pre-truncation unique total per lane,
+    bucket_max the fullest bucket's pre-truncation count — callers
+    detecting per-bucket overflow compare it against out_bucket_rows."""
+    wb, out_r, lanes = _bucketed_check(keys_a, n_buckets, out_bucket_rows)
+    c = keys_a.shape[0]
+    assert lanes % LANES == 0, f"lane count {lanes} must be a multiple of {LANES}"
+    grid = (lanes // LANES,)
+    in_spec = pl.BlockSpec((c, LANES), lambda i: (0, i))
+    out_spec = pl.BlockSpec((n_buckets * out_r, LANES), lambda i: (0, i))
+    nu_spec = pl.BlockSpec((1, LANES), lambda i: (0, i))
+    ko, vo, nu, nbm = pl.pallas_call(
+        _make_bucketed_union_kernel(n_buckets),
+        grid=grid,
+        in_specs=[in_spec] * 4,
+        out_specs=[out_spec, out_spec, nu_spec, nu_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_buckets * out_r, lanes), jnp.int32),
+            jax.ShapeDtypeStruct((n_buckets * out_r, lanes), jnp.int32),
+            jax.ShapeDtypeStruct((1, lanes), jnp.int32),
+            jax.ShapeDtypeStruct((1, lanes), jnp.int32),
+        ],
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=96 * 1024 * 1024,
+        ),
+    )(keys_a, vals_a, _flip_buckets(keys_b, n_buckets),
+      _flip_buckets(vals_b, n_buckets))
+    return ko, vo, nu[0], nbm[0]
+
+
+@partial(jax.jit, static_argnames=("n_buckets", "out_bucket_rows"))
+def bucketed_union_columnar_xla(
+    keys_a: jax.Array,
+    vals_a: jax.Array,
+    keys_b: jax.Array,
+    vals_b: jax.Array,
+    n_buckets: int,
+    out_bucket_rows: int | None = None,
+):
+    """The same contract as :func:`bucketed_union_columnar` through plain
+    XLA (shared _bucketed_union_body) — the CPU bench arm and the
+    single-lane model join's traceable path."""
+    wb, out_r, lanes = _bucketed_check(keys_a, n_buckets, out_bucket_rows)
+    c = keys_a.shape[0]
+    keys, vals = _interleave_buckets(
+        keys_a, vals_a, _flip_buckets(keys_b, n_buckets),
+        _flip_buckets(vals_b, n_buckets), n_buckets)
+    keys, vals, nu_seg = _bucketed_union_body(keys, vals, n_buckets)
+    seg = 2 * c // n_buckets
+    ko = keys.reshape(n_buckets, seg, lanes)[:, :out_r].reshape(
+        n_buckets * out_r, lanes)
+    vo = vals.reshape(n_buckets, seg, lanes)[:, :out_r].reshape(
+        n_buckets * out_r, lanes)
+    return ko, vo, jnp.sum(nu_seg, axis=0), jnp.max(nu_seg, axis=0)

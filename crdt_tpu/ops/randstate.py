@@ -344,6 +344,64 @@ def small_twopset(capacity: int = 16, universe=(3, 7)):
     return out
 
 
+def rand_orset_bitmap(rng, universe: int = 64):
+    """Random dense-layout OR-Set: ``removed`` is masked by ``present`` so
+    every draw is a REACHABLE state (a tombstone implies an observed tag)."""
+    from crdt_tpu.models import orset
+
+    w = (universe + 31) // 32
+    bits = rng.integers(0, 1 << 32, size=(2, w), dtype=np.uint64)
+    present = bits[0].astype(np.uint32).view(np.int32)
+    removed = (bits[1].astype(np.uint32).view(np.int32)) & present
+    return orset.ORSetBitmap(present=jnp.asarray(present),
+                             removed=jnp.asarray(removed))
+
+
+def small_orset_bitmap(universe: int = 64, n_tags: int = 3):
+    """Exhaustive small domain: every (absent | live | tombstoned) state
+    over the first ``n_tags`` tags — 3^n_tags states.  The bitmap join is
+    plane-wise OR, so three tags already exercise every bit interaction."""
+    from crdt_tpu.models import orset
+
+    out = []
+    for code in itertools.product((0, 1, 2), repeat=n_tags):
+        p = r = 0
+        for t, st in enumerate(code):
+            if st:
+                p |= 1 << t
+            if st == 2:
+                r |= 1 << t
+        base = orset.bitmap_empty(universe)
+        out.append(orset.ORSetBitmap(
+            present=base.present.at[0].set(np.int32(p)),
+            removed=base.removed.at[0].set(np.int32(r))))
+    return out
+
+
+def rand_orset_bucketed(rng, capacity: int = 32, n_buckets: int = 4,
+                        fill: int = 2, key_bits: int = 8):
+    """Random bucket-resident OR-Set: up to ``fill`` tags PER BUCKET, keys
+    drawn within each bucket's range slice.  The per-bucket fill keeps the
+    capacity-headroom rule bucket-local: a law-closure join of k operands
+    peaks at k·fill unique tags per bucket, which must stay <= Wb
+    (= capacity / n_buckets) or truncation masquerades as a law violation."""
+    from crdt_tpu.models import orset
+
+    wb = capacity // n_buckets
+    shift = key_bits - (n_buckets.bit_length() - 1)
+    keys = np.full((capacity,), SENTINEL_PY, np.int32)
+    removed = np.zeros((capacity,), np.int32)
+    for b in range(n_buckets):
+        n = int(rng.integers(0, fill + 1))
+        lows = rng.choice(1 << shift, size=n, replace=False)
+        ks = sorted((b << shift) | int(x) for x in lows)
+        keys[b * wb: b * wb + n] = ks
+        removed[b * wb: b * wb + n] = rng.integers(0, 2, size=n)
+    return orset.ORSetBucketed(
+        keys=jnp.asarray(keys), removed=jnp.asarray(removed),
+        n_buckets=n_buckets, key_bits=key_bits)
+
+
 def small_seeded(rand_fn, n: int = 5, seed: int = 0, **kw):
     """Fixed-seed draws from a ``rand_*`` generator — the seed domain for
     lattices too big to enumerate.  Callers pass a tight ``fill`` so the
@@ -365,6 +423,8 @@ BUILTIN_RAND = {
     "gset": rand_gset,
     "twopset": rand_twopset,
     "orset": rand_orset,
+    "orset_bitmap": rand_orset_bitmap,
+    "orset_bucketed": rand_orset_bucketed,
     "rseq": rand_rseq,
     "oplog": rand_oplog,
     "compactlog": rand_compactlog,

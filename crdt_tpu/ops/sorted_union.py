@@ -117,3 +117,13 @@ def _sort_by_keys(keys, vals, n_keys):
     leaves, treedef = jax.tree.flatten(vals)
     out = lax.sort([*keys, *leaves], num_keys=n_keys, is_stable=True)
     return list(out[:n_keys]), jax.tree.unflatten(treedef, out[n_keys:])
+
+
+def get_engine(name: str):
+    """The shared engine seam: resolve a columnar set-union engine by name
+    ("sort" | "bucket" | "bitmap") — see crdt_tpu.ops.union_engine for the
+    layouts, the parity contract, and the auto-dispatch heuristic.  Lazy
+    import keeps this reference module dependency-light."""
+    from crdt_tpu.ops import union_engine
+
+    return union_engine.get_engine(name)

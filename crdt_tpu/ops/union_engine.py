@@ -1,0 +1,432 @@
+"""Second-generation set-union engines + the observable auto-dispatcher.
+
+The sort-path union (crdt_tpu.ops.pallas_union) is the slowest row in
+BENCH_TABLE.md — ~1.2M unions/s at 3.6% of HBM spec, VPU-bound on the
+bitonic compare-exchange network — while the packed-key LWW join runs at
+83% of spec.  PERF.md's floor analysis (benches/orset_floor.py) showed the
+sort kernel sits at the cost of its own pass structure, so the remaining
+lever is restructuring the DATA, not the sort.  This module holds the two
+restructured layouts and the dispatcher that picks between them:
+
+* **bitmap** — when the packed-tag universe is dense enough that
+  ``ceil(U/32)`` words fit the table capacity, a set IS a bitmask plane
+  (``present``/``removed`` int32 words over the universe) and union is
+  literally ``jnp.bitwise_or`` — pure elementwise HBM-bound streaming,
+  the same shape as the PN-counter row that runs at 83% of spec.
+* **bucket** — packed tags range-partitioned into B static buckets per
+  lane (bucket = key >> shift; bucket boundaries are key-order-
+  preserving).  Cross-operand merging happens bucket-locally with SHORT
+  fixed-width merge networks: log2(2·Wb) compare-exchange / prefix /
+  compaction stages instead of log2(2·C) — at C=1024, Wb=16 that is
+  ~18 sublane passes instead of ~36, halving the VPU work the floor
+  analysis proved dominant.  The kernel lives in
+  crdt_tpu.ops.pallas_union (:func:`bucketed_union_columnar`); this
+  module owns the layout conversions and the boundary-level wrapper.
+* **sort** — the proven bitonic path, always correct, the fallback.
+
+**Parity contract** (the certified-parity discipline of "Certified
+Mergeable Replicated Data Types"): every boundary-level engine wrapper in
+:data:`ENGINES` takes the SAME canonical sorted-columnar operands and
+returns bit-identical (keys, vals, n_unique) to the sort path — including
+under ``out_size`` truncation, where all three keep the smallest
+``out_size`` keys and report the pre-truncation unique count.  The
+randomized differential suite (tests/test_union_engines.py) pins this.
+
+**Observability**: every dispatch records its chosen path in a
+process-global tally; :func:`crdt_tpu.obs.health.sample_union_paths`
+mirrors the tally into each node's scraped registry as the
+``union_path{path=...}`` counter, and silent-truncation refusals are
+tallied the same way (the nemesis soak asserts the truncation tally stays
+zero).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.utils.constants import SENTINEL
+
+# packed OR-Set tags span 31 bits (crdt_tpu.ops.pack: elem|rid|seq with the
+# sign bit clear), so bucket shifts default off that width
+PACKED_KEY_BITS = 31
+
+# default bucket width (rows per bucket per lane).  log2(2·16) = 5 merge /
+# prefix / compaction stages per pass family — vs log2(2·1024) = 11 on the
+# full-width sort network at the BASELINE capacity
+DEFAULT_BUCKET_ROWS = 16
+
+# a bucketed layout needs at least a few buckets to beat the sort network;
+# below this capacity the conversion overhead dominates and the planner
+# falls back to the sort path
+MIN_BUCKET_CAPACITY = 64
+
+
+class UnionOverflow(RuntimeError):
+    """A strict set join needed more rows than the table capacity.  The
+    silent alternative (sorted_union's out_size truncation) drops the
+    largest keys — permanent, unrecoverable data loss that also breaks the
+    per-writer seq contiguity GC floors rest on — so the strict variants
+    refuse instead (same stance as tomb_gc.GcOverflow)."""
+
+
+# ---- union-path / truncation tallies ---------------------------------------
+#
+# Process-global and thread-safe: engine dispatch happens inside model-layer
+# host wrappers (never inside a jit — a traced record would count traces,
+# not calls), and the obs layer mirrors the tally into per-node registries
+# at scrape time (crdt_tpu.obs.health.sample_union_paths) so the counter is
+# monotone per registry without the models needing a registry handle.
+
+_TALLY_LOCK = threading.Lock()
+_PATH_TALLY: Dict[str, int] = {}
+_TRUNCATION_TALLY = 0
+
+
+def record_union_path(path: str, n: int = 1, registry=None) -> None:
+    """Count one auto-dispatch decision (``path`` in sort/bucket/bitmap).
+    With ``registry`` the counter is ALSO recorded directly as
+    ``union_path{path=...}`` (callers that own a node registry); the
+    process tally feeds the scrape-time sampler either way."""
+    global _PATH_TALLY
+    with _TALLY_LOCK:
+        _PATH_TALLY[path] = _PATH_TALLY.get(path, 0) + n
+    if registry is not None:
+        registry.inc("union_path", n, path=path)
+
+
+def union_path_counts() -> Dict[str, int]:
+    with _TALLY_LOCK:
+        return dict(_PATH_TALLY)
+
+
+def record_truncation(n: int = 1) -> None:
+    """Count a refused (or detected) capacity truncation.  The nemesis
+    soak asserts this stays ZERO over a whole run: every overflow must
+    surface as a raised UnionOverflow/GcOverflow, never a silent drop."""
+    global _TRUNCATION_TALLY
+    with _TALLY_LOCK:
+        _TRUNCATION_TALLY += n
+
+
+def truncation_count() -> int:
+    with _TALLY_LOCK:
+        return _TRUNCATION_TALLY
+
+
+def reset_tallies() -> None:
+    """Test/soak isolation: zero the process tallies."""
+    global _PATH_TALLY, _TRUNCATION_TALLY
+    with _TALLY_LOCK:
+        _PATH_TALLY = {}
+        _TRUNCATION_TALLY = 0
+
+
+# ---- dispatcher -------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class UnionPlan:
+    """One dispatch decision: which engine serves a join and why."""
+
+    path: str                      # "bitmap" | "bucket" | "sort"
+    reason: str
+    universe: Optional[int] = None   # bitmap: declared tag universe
+    n_buckets: Optional[int] = None  # bucket: static bucket count
+    key_bits: int = PACKED_KEY_BITS
+
+
+def bitmap_words(universe: int) -> int:
+    """int32 words per lane a presence bitmap over ``universe`` tags needs."""
+    return (int(universe) + 31) // 32
+
+
+def plan_union(capacity: int, *, universe: Optional[int] = None,
+               key_bits: int = PACKED_KEY_BITS,
+               bucket_rows: int = DEFAULT_BUCKET_ROWS) -> UnionPlan:
+    """The capacity/density/bit-budget heuristic behind ``engine="auto"``.
+
+    * **dense → bitmap**: a caller-declared tag universe whose bitmap
+      (``ceil(U/32)`` words) fits within ``capacity`` rows moves no more
+      bytes than the sorted table does — and unions elementwise.  Above
+      that bound the bitmap would stream MORE bytes than the sort path
+      (traffic-parity bound: U ≤ 32·C), so density is exactly what the
+      capacity comparison tests.
+    * **key-budget sparse → bucket**: packed keys with a known bit width
+      range-partition into static buckets; worth the conversion once
+      capacity admits enough buckets (``capacity >= MIN_BUCKET_CAPACITY``).
+    * **over-budget → sort**: everything else rides the proven bitonic
+      path.
+    """
+    if universe is not None and bitmap_words(universe) <= capacity:
+        return UnionPlan(
+            path="bitmap",
+            reason=f"universe {universe} fits {bitmap_words(universe)} "
+                   f"words <= capacity {capacity} (traffic parity)",
+            universe=int(universe), key_bits=key_bits)
+    if (key_bits <= PACKED_KEY_BITS and capacity >= MIN_BUCKET_CAPACITY
+            and capacity & (capacity - 1) == 0):
+        nb = max(2, capacity // bucket_rows)
+        return UnionPlan(
+            path="bucket",
+            reason=f"{nb} buckets x {capacity // nb} rows over a "
+                   f"{key_bits}-bit key space",
+            n_buckets=nb, key_bits=key_bits)
+    why = ("universe undeclared or over the 32*capacity traffic-parity "
+           "bound" if universe is None or bitmap_words(universe) > capacity
+           else "capacity below the bucketed minimum")
+    return UnionPlan(path="sort", reason=why, key_bits=key_bits)
+
+
+# ---- bitmap layout ----------------------------------------------------------
+#
+# A set over a declared tag universe U is two int32 bit planes of
+# ceil(U/32) words per lane: ``present`` (tag observed) and ``removed``
+# (tombstone — monotone, removed ⊆ present in any reachable state).  The
+# join is elementwise OR of both planes: associative, commutative,
+# idempotent BY STRUCTURE (the jaxpr-level ACI gate can verify it without
+# runtime sweeps), and pure HBM streaming on chip.
+
+
+@partial(jax.jit, static_argnames=("universe",))
+def sorted_to_bitmap(keys: jax.Array, vals: jax.Array, universe: int):
+    """Canonical sorted planes (keys int32[C, L] asc + SENTINEL padding,
+    vals 0/1 int32[C, L]) → (present, removed) int32[W, L] bit planes.
+    Keys must be < ``universe``; rows at or above it are the caller's bug
+    (the checked model wrappers validate host-side)."""
+    w = bitmap_words(universe)
+    c, lanes = keys.shape
+    valid = keys != SENTINEL
+    word = jnp.where(valid, keys >> 5, w)          # invalid -> overflow row
+    bit = jnp.where(valid, keys & 31, 0)
+    one = jnp.where(valid, jnp.int32(1) << bit, 0)
+    lane = jnp.broadcast_to(jnp.arange(lanes)[None, :], (c, lanes))
+    # unique keys per lane => distinct bits, so scatter-add == scatter-or
+    present = jnp.zeros((w + 1, lanes), jnp.int32).at[word, lane].add(one)
+    removed = jnp.zeros((w + 1, lanes), jnp.int32).at[word, lane].add(
+        jnp.where(vals != 0, one, 0)
+    )
+    return present[:w], removed[:w]
+
+
+@jax.jit
+def bitmap_union(present_a, removed_a, present_b, removed_b):
+    """THE bitmap fast path: set union == bitwise OR of both planes."""
+    return present_a | present_b, removed_a | removed_b
+
+
+@jax.jit
+def bitmap_count(present: jax.Array) -> jax.Array:
+    """int32[L]: live tag count per lane (popcount over the word plane)."""
+    return jnp.sum(jax.lax.population_count(present), axis=0).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("out_size",))
+def bitmap_to_sorted(present: jax.Array, removed: jax.Array, out_size: int):
+    """Bit planes → canonical sorted layout, bit-identical to the sort
+    path's output at the same ``out_size`` (ascending keys, smallest kept
+    on truncation, pad vals zeroed, n_unique pre-truncation)."""
+    w, lanes = present.shape
+    u = w * 32
+    bits = jnp.arange(32, dtype=jnp.int32)
+    # (W, 32, L) bit expansion; arithmetic >> keeps bit 31 correct in int32
+    pres = ((present[:, None, :] >> bits[None, :, None]) & 1) != 0
+    rem = (removed[:, None, :] >> bits[None, :, None]) & 1
+    tag = (jnp.arange(w, dtype=jnp.int32) * 32)[:, None] + bits[None, :]
+    keysf = jnp.where(pres, tag[:, :, None], SENTINEL).reshape(u, lanes)
+    remf = jnp.where(pres, rem, 0).reshape(u, lanes)
+    # truncation keeps the SMALLEST out_size keys, so the conversion is a
+    # per-lane bottom-k selection, not a full sort: top_k over negated keys
+    # (SENTINEL-padded absent rows sort to the back; their rem is 0, so tie
+    # order among them is immaterial)
+    k = min(out_size, u)
+    negv, idx = jax.lax.top_k(-keysf.T, k)
+    keys = (-negv).T
+    vals = jnp.take_along_axis(remf.T, idx, axis=1).T
+    return keys, vals, bitmap_count(present)
+
+
+# ---- bucketed layout --------------------------------------------------------
+#
+# The bucketed layout reuses the (C, L) sorted-columnar planes but groups
+# rows into B segments of Wb = C/B rows; segment b holds only keys whose
+# top bits equal b (bucket = key >> (key_bits - log2 B)), each segment
+# sorted ascending with its own SENTINEL tail.  Because the partition is
+# key-order-preserving, concatenated segment contents remain globally
+# sorted (with interior padding runs) — conversion back to canonical form
+# is one stable sort.  The union kernel itself lives in
+# crdt_tpu.ops.pallas_union (shared jnp body, Pallas + XLA callers).
+
+
+def bucket_shift(n_buckets: int, key_bits: int = PACKED_KEY_BITS) -> int:
+    lb = n_buckets.bit_length() - 1
+    assert 1 << lb == n_buckets, f"n_buckets {n_buckets} must be a power of 2"
+    assert lb <= key_bits, f"{n_buckets} buckets exceed a {key_bits}-bit key"
+    return key_bits - lb
+
+
+@partial(jax.jit, static_argnames=("n_buckets", "key_bits"))
+def sorted_to_bucketed(keys: jax.Array, vals: jax.Array, n_buckets: int,
+                       key_bits: int = PACKED_KEY_BITS):
+    """Canonical sorted planes → bucketed planes + per-lane dropped-row
+    count (rows whose bucket was already full, or whose key exceeded the
+    declared bit budget).  ``dropped`` must be ZERO for the layout to be
+    faithful — the checked wrappers fall back to the sort path otherwise."""
+    c, lanes = keys.shape
+    wb = c // n_buckets
+    assert wb * n_buckets == c, f"{n_buckets} buckets must divide C={c}"
+    shift = bucket_shift(n_buckets, key_bits)
+    valid = keys != SENTINEL
+    bucket = jnp.where(valid, keys >> shift, n_buckets)
+    # rows of one bucket are contiguous (keys sorted); the index within a
+    # bucket is the distance from the start of its run
+    i = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32)[:, None], (c, lanes))
+    prev_b = jnp.concatenate([jnp.full((1, lanes), -1, bucket.dtype),
+                              bucket[:-1]], axis=0)
+    run_start = jax.lax.cummax(jnp.where(bucket != prev_b, i, 0), axis=0)
+    idx = i - run_start
+    ok = valid & (bucket < n_buckets) & (idx < wb)
+    target = jnp.where(ok, bucket.astype(jnp.int32) * wb + idx, c)
+    lane = jnp.broadcast_to(jnp.arange(lanes)[None, :], (c, lanes))
+    out_keys = jnp.full((c + 1, lanes), SENTINEL, jnp.int32).at[
+        target, lane].set(keys)
+    out_vals = jnp.zeros((c + 1, lanes), jnp.int32).at[target, lane].set(
+        jnp.where(ok, vals, 0))
+    dropped = jnp.sum(valid & ~ok, axis=0).astype(jnp.int32)
+    return out_keys[:c], out_vals[:c], dropped
+
+
+@jax.jit
+def bucketed_to_sorted(keys: jax.Array, vals: jax.Array):
+    """Bucketed planes → canonical sorted planes (+ n_unique[L]).  Segment
+    contents are already in global key order, so this only sinks the
+    interior padding runs: one stable single-key sort."""
+    keys, vals = jax.lax.sort([keys, vals], dimension=0, num_keys=1,
+                              is_stable=True)
+    pad = keys == SENTINEL
+    vals = jnp.where(pad, 0, vals)
+    n_unique = jnp.sum(~pad, axis=0).astype(jnp.int32)
+    return keys, vals, n_unique
+
+
+# ---- boundary-level engine wrappers ----------------------------------------
+#
+# One uniform signature over the CANONICAL sorted-columnar operands:
+#
+#   engine(keys_a, vals_a, keys_b, vals_b, out_size, *, interpret=False,
+#          **plan_kwargs) -> (keys[out, L], vals[out, L], n_unique[L])
+#
+# bit-identical across engines (the differential suite's contract).  The
+# bucket/bitmap wrappers pay conversion costs at this boundary; the WIN
+# comes from staying resident in the restructured layout across chained
+# unions (benches/bench_orset.py's steady-state arms), not from one-shot
+# calls through these wrappers.
+
+
+def engine_sort(keys_a, vals_a, keys_b, vals_b, out_size, *,
+                interpret: bool = False, **_kw):
+    from crdt_tpu.ops import pallas_union
+
+    return pallas_union.sorted_union_columnar(
+        keys_a, vals_a, keys_b, vals_b, out_size=out_size,
+        interpret=interpret)
+
+
+def engine_bucket(keys_a, vals_a, keys_b, vals_b, out_size, *,
+                  interpret: bool = False, n_buckets: Optional[int] = None,
+                  key_bits: int = PACKED_KEY_BITS, use_kernel: bool = True,
+                  **_kw):
+    """Sorted → bucketed → bucket-local union (LOSSLESS: the union output
+    keeps 2·Wb rows per bucket, so a single union can never overflow a
+    bucket) → sorted, truncated to ``out_size`` globally — the exact
+    truncation rule of the sort path.
+
+    The operand CONVERSION can overflow a bucket when one operand holds
+    more than Wb keys of a single bucket; ``sorted_to_bucketed`` reports
+    those as dropped rows, and this wrapper falls back to the sort path
+    (host-side check — this is a boundary wrapper, never traced), keeping
+    the bit-parity contract unconditional."""
+    from crdt_tpu.ops import pallas_union
+
+    c = keys_a.shape[0]
+    nb = n_buckets if n_buckets is not None else max(2, c // DEFAULT_BUCKET_ROWS)
+    wb = c // nb
+    ka, va, da = sorted_to_bucketed(keys_a, vals_a, nb, key_bits)
+    kb, vb, db = sorted_to_bucketed(keys_b, vals_b, nb, key_bits)
+    if bool(jnp.any(da != 0)) or bool(jnp.any(db != 0)):
+        return engine_sort(keys_a, vals_a, keys_b, vals_b, out_size,
+                           interpret=interpret)
+    union = (pallas_union.bucketed_union_columnar if use_kernel
+             else pallas_union.bucketed_union_columnar_xla)
+    kw = {"interpret": interpret} if use_kernel else {}
+    ko, vo, nu, _ = union(ka, va, kb, vb, n_buckets=nb,
+                          out_bucket_rows=2 * wb, **kw)
+    keys, vals, _ = bucketed_to_sorted(ko, vo)
+    return keys[:out_size], vals[:out_size], nu
+
+
+def engine_bitmap(keys_a, vals_a, keys_b, vals_b, out_size, *,
+                  universe: Optional[int] = None, **_kw):
+    assert universe is not None, "the bitmap engine needs a declared universe"
+    pa, ra = sorted_to_bitmap(keys_a, vals_a, universe)
+    pb, rb = sorted_to_bitmap(keys_b, vals_b, universe)
+    p, r = bitmap_union(pa, ra, pb, rb)
+    return bitmap_to_sorted(p, r, out_size)
+
+
+ENGINES = {
+    "sort": engine_sort,
+    "bucket": engine_bucket,
+    "bitmap": engine_bitmap,
+}
+
+
+def get_engine(name: str):
+    if name not in ENGINES:
+        raise KeyError(f"unknown union engine {name!r}; known: "
+                       f"{sorted(ENGINES)}")
+    return ENGINES[name]
+
+
+def dispatch_union(keys_a, vals_a, keys_b, vals_b, out_size, *,
+                   engine: str = "auto", universe: Optional[int] = None,
+                   interpret: bool = False, registry=None):
+    """Plan + record + run one boundary-level union over canonical sorted
+    operands.  ``engine="auto"`` consults :func:`plan_union`; a named
+    engine pins the path (still recorded).  Returns
+    (keys, vals, n_unique, path)."""
+    capacity = keys_a.shape[0]
+    if engine == "auto":
+        plan = plan_union(capacity, universe=universe)
+    else:
+        plan = UnionPlan(path=engine, reason="caller-pinned",
+                         universe=universe,
+                         n_buckets=max(2, capacity // DEFAULT_BUCKET_ROWS))
+    record_union_path(plan.path, registry=registry)
+    # only the Pallas-tiled paths need 128-lane alignment; the bitmap
+    # engine is plain XLA, and padding it would multiply the O(universe)
+    # conversion work by LANES/lanes
+    lanes = keys_a.shape[1]
+    from crdt_tpu.ops import pallas_union
+    pad = 0 if plan.path == "bitmap" else (-lanes) % pallas_union.LANES
+    if pad:
+        def padk(k):
+            return jnp.pad(k, ((0, 0), (0, pad)),
+                           constant_values=int(SENTINEL))
+
+        def padv(v):
+            return jnp.pad(v, ((0, 0), (0, pad)))
+
+        keys_a, keys_b = padk(keys_a), padk(keys_b)
+        vals_a, vals_b = padv(vals_a), padv(vals_b)
+    keys, vals, n = get_engine(plan.path)(
+        keys_a, vals_a, keys_b, vals_b, out_size,
+        interpret=interpret, universe=plan.universe,
+        n_buckets=plan.n_buckets, key_bits=plan.key_bits)
+    if pad:
+        keys, vals, n = keys[:, :lanes], vals[:, :lanes], n[:lanes]
+    return keys, vals, n, plan.path

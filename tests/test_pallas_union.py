@@ -241,6 +241,7 @@ def test_lexn_union_matches_generic(n_keys):
         assert int(n) == int(nu[j])
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 @pytest.mark.parametrize("stripe", [8, 16, 32, 64])
 def test_striped_lexn_matches_fused(stripe):
     """Round-5: the capacity-striped union (block-bitonic merge of sorted
@@ -266,6 +267,7 @@ def test_striped_lexn_matches_fused(stripe):
             np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 @pytest.mark.parametrize("stripe", [8, 32, 64])
 def test_striped_kernel_epilogue_matches_sort(stripe):
     """Round-5: the compaction-only Pallas kernel epilogue

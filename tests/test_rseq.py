@@ -243,6 +243,7 @@ def test_same_gap_storm_10k_alloc_level():
     assert labels[1:-1] == list(range(9_999, -1, -1))
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 def test_same_gap_storm_device_table():
     """A 1.5K-op fixed-index storm through the real device table: no
     GapExhausted, no capacity overflow, order preserved end to end."""
@@ -463,6 +464,7 @@ def test_append_and_prepend_use_stride_not_bisection():
     assert w2.to_list() == list(range(79, -1, -1))
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 def test_seqwriter_from_gc_wrapper_is_floor_aware():
     """Advisor round 2: constructing a SeqWriter from the tomb_gc.Gc
     wrapper must resume ABOVE the floor — after GC collected a writer's

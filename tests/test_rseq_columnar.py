@@ -209,6 +209,7 @@ def test_merge_rejects_mismatched_layouts():
         rc.merge_checked(ca, cb)
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 def test_sharded_converge_matches_single_device():
     """The lexN kernel under shard_map over the 8-device virtual mesh must
     agree with the single-device converge (and with the generic path via

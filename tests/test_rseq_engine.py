@@ -77,6 +77,7 @@ def test_pairwise_join_equivalence(seed):
     assert_gc_equal(g_rev, g_col)
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 def test_barrier_equivalence_with_dead_lane():
     a, b = diverged_pair(3)
     c = edited_state(5)
@@ -110,6 +111,7 @@ def test_fallback_is_loud():
     assert int(nu) == 0
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 def test_soak_rides_columnar_engine():
     """The seq soak's default engine is the columnar one — a short sweep
     must pass with fallback warnings escalated to errors (proving every
@@ -122,6 +124,7 @@ def test_soak_rides_columnar_engine():
     assert report.steps == 30
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 def test_sharded_gc_converge_matches_generic():
     """Round-5 (round-4 verdict missing #1): the GC-aware converge under
     shard_map over the 8-device virtual mesh — per-lane floor planes

@@ -5,6 +5,7 @@ import pytest
 from crdt_tpu.harness.seq_soak import SeqSoakRunner
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 @pytest.mark.parametrize("seed", [0, 1])
 def test_seq_soak_short(seed):
     report = SeqSoakRunner(n=3, seed=seed, capacity=256).run(120)
@@ -12,6 +13,7 @@ def test_seq_soak_short(seed):
     assert report.inserts > 0 and report.joins > 0
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 def test_seq_soak_exercises_gc_and_restarts():
     """A delete-heavy schedule with frequent barriers and restarts: rows
     must be reclaimed and restarted cursors must keep editing safely."""

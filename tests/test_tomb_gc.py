@@ -259,6 +259,7 @@ def test_next_seq_is_floor_aware():
 # ---- RSeq adapter ----------------------------------------------------------
 
 
+@pytest.mark.slow  # interpret-mode e2e: minutes on the CPU tier-1 runner
 def test_rseq_gc_reclaims_and_preserves_order():
     w = rseq.SeqWriter(rseq.empty(CAP), rid=0)
     for i in range(20):

@@ -1,0 +1,388 @@
+"""Randomized differential suite over the three set-union engines.
+
+The parity contract (crdt_tpu.ops.union_engine): every engine takes the
+same canonical sorted-columnar operands and returns bit-identical
+(keys, vals, n_unique) to the proven sort path — including under out_size
+truncation.  This suite drives all three paths over identical operand
+traces (duplicate-heavy, sentinel-edge, capacity-boundary, empty) plus a
+host python-set oracle, and pins the auto-dispatch heuristic and the
+union_path observability counters.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from crdt_tpu.models import gset, orset
+from crdt_tpu.ops import pack, pallas_union, randstate as rs, union_engine as ue
+from crdt_tpu.utils.constants import SENTINEL_PY
+
+C, L = 64, 128
+KEY_BITS = 12
+UNIVERSE = 1 << KEY_BITS
+
+
+def _mk(rng, fill, space=UNIVERSE, exact=False):
+    """Random sorted-columnar operand planes: per-lane sorted unique keys
+    with SENTINEL tail, 0/1 tombstone values."""
+    ks = np.full((C, L), SENTINEL_PY, np.int32)
+    vs = np.zeros((C, L), np.int32)
+    for lane in range(L):
+        n = fill if exact else int(rng.integers(0, fill + 1))
+        keys = np.sort(rng.choice(space, size=n, replace=False)).astype(np.int32)
+        ks[:n, lane] = keys
+        vs[:n, lane] = rng.integers(0, 2, size=n)
+    return jnp.asarray(ks), jnp.asarray(vs)
+
+
+def _overlapping(rng, fill):
+    """Duplicate-heavy pair: B replays most of A's keys with flipped
+    tombstones, so the OR-combine path is exercised on nearly every row."""
+    ka, va = _mk(rng, fill)
+    kb = np.asarray(ka).copy()
+    vb = 1 - np.asarray(va)
+    # sprinkle a few fresh keys into B's padding
+    for lane in range(0, L, 7):
+        n = int(np.sum(kb[:, lane] != SENTINEL_PY))
+        extra = min(3, C - n)
+        fresh = rng.choice(UNIVERSE, size=extra, replace=False).astype(np.int32)
+        col = np.concatenate([kb[:n, lane], fresh])
+        order = np.argsort(col, kind="stable")
+        kb[: n + extra, lane] = col[order]
+        vb[: n + extra, lane] = np.concatenate(
+            [vb[:n, lane], rng.integers(0, 2, size=extra)])[order]
+    return (ka, va), (jnp.asarray(kb), jnp.asarray(np.where(
+        kb == SENTINEL_PY, 0, vb).astype(np.int32)))
+
+
+def _oracle(ka, va, kb, vb, out_size):
+    """Host python-dict union: OR on duplicate keys, sorted, truncated."""
+    keys_out = np.full((out_size, L), SENTINEL_PY, np.int32)
+    vals_out = np.zeros((out_size, L), np.int32)
+    n_out = np.zeros((L,), np.int32)
+    ka, va, kb, vb = map(np.asarray, (ka, va, kb, vb))
+    for lane in range(L):
+        d = {}
+        for k, v in zip(ka[:, lane], va[:, lane]):
+            if k != SENTINEL_PY:
+                d[int(k)] = d.get(int(k), 0) | int(v)
+        for k, v in zip(kb[:, lane], vb[:, lane]):
+            if k != SENTINEL_PY:
+                d[int(k)] = d.get(int(k), 0) | int(v)
+        items = sorted(d.items())[:out_size]
+        for i, (k, v) in enumerate(items):
+            keys_out[i, lane] = k
+            vals_out[i, lane] = v
+        n_out[lane] = len(d)
+    return keys_out, vals_out, n_out
+
+
+def _run_all(ka, va, kb, vb, out_size):
+    sort = pallas_union.sorted_union_columnar(
+        ka, va, kb, vb, out_size=out_size, interpret=True)
+    bucket = ue.engine_bucket(ka, va, kb, vb, out_size,
+                              use_kernel=False, interpret=True,
+                              key_bits=KEY_BITS)
+    bucket_k = ue.engine_bucket(ka, va, kb, vb, out_size,
+                                use_kernel=True, interpret=True,
+                                key_bits=KEY_BITS)
+    bitmap = ue.engine_bitmap(ka, va, kb, vb, out_size, universe=UNIVERSE)
+    return {"sort": sort, "bucket": bucket, "bucket_kernel": bucket_k,
+            "bitmap": bitmap}
+
+
+def _assert_identical(results, oracle=None):
+    ref = results["sort"]
+    for name, out in results.items():
+        for i, part in enumerate(("keys", "vals", "count")):
+            np.testing.assert_array_equal(
+                np.asarray(ref[i]), np.asarray(out[i]),
+                err_msg=f"engine {name} diverges from sort on {part}")
+    if oracle is not None:
+        for i, part in enumerate(("keys", "vals", "count")):
+            np.testing.assert_array_equal(
+                oracle[i], np.asarray(ref[i]),
+                err_msg=f"sort path diverges from host oracle on {part}")
+
+
+@pytest.mark.parametrize("fill", [0, 3, 20, 40])
+def test_engines_bit_identical_random(fill):
+    rng = np.random.default_rng(fill)
+    ka, va = _mk(rng, fill)
+    kb, vb = _mk(rng, fill)
+    _assert_identical(_run_all(ka, va, kb, vb, C),
+                      _oracle(ka, va, kb, vb, C))
+
+
+def test_engines_bit_identical_duplicate_heavy():
+    rng = np.random.default_rng(7)
+    (ka, va), (kb, vb) = _overlapping(rng, 30)
+    _assert_identical(_run_all(ka, va, kb, vb, C),
+                      _oracle(ka, va, kb, vb, C))
+
+
+def test_engines_bit_identical_empty_operands():
+    rng = np.random.default_rng(8)
+    ka, va = _mk(rng, 10)
+    ke, ve = _mk(rng, 0)  # all-SENTINEL
+    for a, b in [((ka, va), (ke, ve)), ((ke, ve), (ka, va)),
+                 ((ke, ve), (ke, ve))]:
+        _assert_identical(_run_all(a[0], a[1], b[0], b[1], C))
+
+
+def test_engines_bit_identical_sentinel_edge():
+    """Largest real key (UNIVERSE - 1, top bucket, top bitmap bit) and
+    key 0 both present — the boundary rows of every layout."""
+    rng = np.random.default_rng(9)
+    ks = np.full((C, L), SENTINEL_PY, np.int32)
+    vs = np.zeros((C, L), np.int32)
+    for lane in range(L):
+        mids = rng.choice(np.arange(1, UNIVERSE - 1), size=18, replace=False)
+        keys = np.sort(np.concatenate(
+            [[0, UNIVERSE - 1], mids])).astype(np.int32)
+        ks[:20, lane] = keys
+        vs[:20, lane] = rng.integers(0, 2, size=20)
+    ka, va = jnp.asarray(ks), jnp.asarray(vs)
+    kb, vb = _mk(rng, 20)
+    _assert_identical(_run_all(ka, va, kb, vb, C))
+
+
+def test_engines_bit_identical_capacity_boundary():
+    """Both operands full: the union truncates (all engines must keep the
+    SMALLEST out_size keys and report the pre-truncation count)."""
+    rng = np.random.default_rng(10)
+    ka, va = _mk(rng, C, exact=True)
+    kb, vb = _mk(rng, C, exact=True)
+    results = _run_all(ka, va, kb, vb, C)
+    _assert_identical(results, _oracle(ka, va, kb, vb, C))
+    assert int(np.max(np.asarray(results["sort"][2]))) > C  # truly truncated
+
+
+# ---- layout conversions -----------------------------------------------------
+
+
+def test_bucketed_roundtrip():
+    rng = np.random.default_rng(11)
+    ka, va = _mk(rng, 12)
+    kb2, vb2, dropped = ue.sorted_to_bucketed(ka, va, 8, KEY_BITS)
+    assert int(jnp.sum(dropped)) == 0
+    k3, v3, n3 = ue.bucketed_to_sorted(kb2, vb2)
+    np.testing.assert_array_equal(np.asarray(ka), np.asarray(k3))
+    np.testing.assert_array_equal(np.asarray(va), np.asarray(v3))
+    np.testing.assert_array_equal(
+        np.sum(np.asarray(ka) != SENTINEL_PY, axis=0), np.asarray(n3))
+
+
+def test_bitmap_roundtrip():
+    rng = np.random.default_rng(12)
+    ka, va = _mk(rng, 12)
+    p, r = ue.sorted_to_bitmap(ka, va, UNIVERSE)
+    k3, v3, n3 = ue.bitmap_to_sorted(p, r, C)
+    np.testing.assert_array_equal(np.asarray(ka), np.asarray(k3))
+    np.testing.assert_array_equal(np.asarray(va), np.asarray(v3))
+    np.testing.assert_array_equal(
+        np.sum(np.asarray(ka) != SENTINEL_PY, axis=0), np.asarray(n3))
+
+
+def test_bitmap_top_bit_word_boundary():
+    """Bit 31 of a word packs as a NEGATIVE int32 — OR/popcount/extract
+    must still round-trip it."""
+    ks = np.full((C, L), SENTINEL_PY, np.int32)
+    ks[0, :] = 31   # bit 31 of word 0
+    ks[1, :] = 63   # bit 31 of word 1
+    vs = np.zeros((C, L), np.int32)
+    vs[0, :] = 1
+    ka, va = jnp.asarray(ks), jnp.asarray(vs)
+    p, r = ue.sorted_to_bitmap(ka, va, 64)
+    assert int(np.asarray(p)[0, 0]) < 0  # bit 31 set -> negative word
+    k3, v3, n3 = ue.bitmap_to_sorted(p, r, C)
+    np.testing.assert_array_equal(np.asarray(ka), np.asarray(k3))
+    np.testing.assert_array_equal(np.asarray(va), np.asarray(v3))
+    assert int(np.asarray(n3)[0]) == 2
+
+
+# ---- dispatcher + observability ---------------------------------------------
+
+
+def test_plan_dense_universe_goes_bitmap():
+    # traffic-parity bound: ceil(U/32) words <= capacity rows
+    plan = ue.plan_union(C, universe=32 * C)
+    assert plan.path == "bitmap"
+    assert ue.plan_union(C, universe=32 * C + 1).path != "bitmap"
+
+
+def test_plan_sparse_goes_bucket_then_sort():
+    assert ue.plan_union(1024).path == "bucket"
+    # over the key-bit budget -> sort
+    assert ue.plan_union(1024, key_bits=40).path == "sort"
+    # below the bucketed minimum -> sort
+    assert ue.plan_union(16).path == "sort"
+    # non-power-of-two capacity -> sort
+    assert ue.plan_union(96).path == "sort"
+    # universe too wide for traffic parity -> not bitmap
+    assert ue.plan_union(C, universe=33 * 32 * C).path != "bitmap"
+
+
+def test_dispatch_records_union_path_tally():
+    ue.reset_tallies()
+    rng = np.random.default_rng(13)
+    ka, va = _mk(rng, 5, space=1024)
+    kb, vb = _mk(rng, 5, space=1024)
+    _, _, _, p1 = ue.dispatch_union(ka, va, kb, vb, C, engine="auto",
+                                    universe=1024, interpret=True)
+    _, _, _, p2 = ue.dispatch_union(ka, va, kb, vb, C, engine="sort",
+                                    interpret=True)
+    assert p1 == "bitmap" and p2 == "sort"
+    counts = ue.union_path_counts()
+    assert counts["bitmap"] == 1 and counts["sort"] == 1
+
+
+def test_sampler_converges_tally_into_registry_monotone():
+    from crdt_tpu.obs import health
+    from crdt_tpu.obs.registry import MetricsRegistry
+
+    ue.reset_tallies()
+    reg = MetricsRegistry()
+    health.sample_union_paths(reg)
+    # the series exists even before any join ran
+    assert reg.counter_value("union_path", path="sort") == 0
+    ue.record_union_path("bitmap", 3)
+    health.sample_union_paths(reg)
+    health.sample_union_paths(reg)  # idempotent: no double count
+    assert reg.counter_value("union_path", path="bitmap") == 3
+    ue.record_union_path("bitmap")
+    health.sample_union_paths(reg)
+    assert reg.counter_value("union_path", path="bitmap") == 4
+    assert "crdt_union_path_total" in reg.render_prometheus()
+
+
+def test_record_union_path_direct_registry():
+    from crdt_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    ue.record_union_path("bucket", registry=reg)
+    assert reg.counter_value("union_path", path="bucket") == 1
+
+
+# ---- pack hardening + strict joins ------------------------------------------
+
+
+def test_pack_tags_checked_raises_per_field():
+    ok = np.array([1, 2], np.int32)
+    with pytest.raises(ValueError, match="elem"):
+        pack.pack_tags_checked(np.array([1 << 14], np.int32), ok[:1], ok[:1])
+    with pytest.raises(ValueError, match="rid"):
+        pack.pack_tags_checked(ok[:1], np.array([64], np.int32), ok[:1])
+    with pytest.raises(ValueError, match="seq"):
+        pack.pack_tags_checked(ok[:1], ok[:1], np.array([1 << 11], np.int32))
+    with pytest.raises(ValueError, match="rid"):
+        pack.pack_tags_checked(ok[:1], np.array([-1], np.int32), ok[:1])
+    # valid mask exempts padding rows
+    got = pack.pack_tags_checked(
+        np.array([3, 1 << 20], np.int32), np.array([2, 99], np.int32),
+        np.array([7, -5], np.int32), valid=np.array([True, False]))
+    assert int(np.asarray(got)[0]) == int(np.asarray(
+        pack.pack_tags(jnp.asarray([3]), jnp.asarray([2]),
+                       jnp.asarray([7])))[0])
+
+
+def test_stack_to_columnar_rejects_over_budget_tags():
+    s = orset.empty(8)
+    s = orset.add(s, 5, 1, (1 << 11) + 3)  # seq over budget
+    with pytest.raises(ValueError, match="seq"):
+        orset.stack_to_columnar([s])
+
+
+def test_orset_join_strict_raises_and_tallies():
+    ue.reset_tallies()
+    a = orset.empty(2)
+    a = orset.add(a, 1, 0, 0)
+    a = orset.add(a, 2, 0, 1)
+    b = orset.empty(2)
+    b = orset.add(b, 3, 1, 0)
+    with pytest.raises(ue.UnionOverflow):
+        orset.join_strict(a, b)
+    assert ue.truncation_count() == 1
+    # non-overflowing joins pass through untouched
+    got = orset.join_strict(a, a)
+    np.testing.assert_array_equal(np.asarray(a.elem), np.asarray(got.elem))
+    assert ue.truncation_count() == 1
+
+
+def test_gset_join_strict_raises():
+    a = gset.GSet(elem=jnp.asarray([1, 2], jnp.int32))
+    b = gset.GSet(elem=jnp.asarray([3, 4], jnp.int32))
+    with pytest.raises(ue.UnionOverflow):
+        gset.g_join_strict(a, b)
+    got = gset.g_join_strict(a, a)
+    np.testing.assert_array_equal(np.asarray(a.elem), np.asarray(got.elem))
+
+
+def test_gset_join_auto_bitmap_parity_and_tally():
+    ue.reset_tallies()
+    a = gset.GSet(elem=jnp.asarray([1, 5, 9, SENTINEL_PY], jnp.int32))
+    b = gset.GSet(elem=jnp.asarray([2, 5, 30, SENTINEL_PY], jnp.int32))
+    ref = gset.g_join(a, b)
+    got = gset.g_join_auto(a, b, universe=64)
+    np.testing.assert_array_equal(np.asarray(ref.elem), np.asarray(got.elem))
+    assert ue.union_path_counts() == {"bitmap": 1}
+    # no universe declared -> sort fallback, still recorded
+    got2 = gset.g_join_auto(a, b)
+    np.testing.assert_array_equal(np.asarray(ref.elem), np.asarray(got2.elem))
+    assert ue.union_path_counts() == {"bitmap": 1, "sort": 1}
+
+
+# ---- resident model layouts -------------------------------------------------
+
+
+def test_orset_bitmap_join_matches_canonical():
+    rng = np.random.default_rng(20)
+    a = rs.rand_orset(rng)
+    b = rs.rand_orset(rng)
+    universe = 1 << 20  # covers the packed (6, 3, 50) tag space
+    ja = orset.join(a, b)
+    jb = orset.from_bitmap(
+        orset.bitmap_join(orset.to_bitmap(a, universe),
+                          orset.to_bitmap(b, universe)), a.capacity)
+    for f in ("elem", "rid", "seq", "removed"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ja, f)), np.asarray(getattr(jb, f)),
+            err_msg=f"bitmap-resident join diverges on {f}")
+
+
+def test_orset_bucketed_join_matches_canonical():
+    rng = np.random.default_rng(21)
+    a = rs.rand_orset(rng)
+    b = rs.rand_orset(rng)
+    ja = orset.join(a, b)
+    jb = orset.from_bucketed(
+        orset.bucketed_join(orset.to_bucketed(a, 2, key_bits=20),
+                            orset.to_bucketed(b, 2, key_bits=20)))
+    for f in ("elem", "rid", "seq", "removed"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ja, f)), np.asarray(getattr(jb, f)),
+            err_msg=f"bucket-resident join diverges on {f}")
+
+
+def test_to_bucketed_refuses_bucket_overflow():
+    s = orset.empty(8)
+    for i in range(5):
+        s = orset.add(s, 0, 0, i)  # five tags, one elem -> one bucket
+    with pytest.raises(ue.UnionOverflow):
+        orset.to_bucketed(s, 4, key_bits=20)  # wb = 2 < 5
+
+
+def test_columnar_join_engine_param_parity():
+    rng = np.random.default_rng(22)
+    sets_a = [rs.rand_orset(rng) for _ in range(4)]
+    sets_b = [rs.rand_orset(rng) for _ in range(4)]
+    pa, ra = orset.stack_to_columnar(sets_a)
+    pb, rb = orset.stack_to_columnar(sets_b)
+    ue.reset_tallies()
+    ref = orset.columnar_join(pa, ra, pb, rb, out_size=16, interpret=True)
+    got = orset.columnar_join(pa, ra, pb, rb, out_size=16, interpret=True,
+                              engine="bitmap", universe=1 << 20)
+    for i in range(3):
+        np.testing.assert_array_equal(np.asarray(ref[i]), np.asarray(got[i]))
+    assert ue.union_path_counts() == {"sort": 1, "bitmap": 1}
