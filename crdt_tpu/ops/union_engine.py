@@ -35,9 +35,10 @@ randomized differential suite (tests/test_union_engines.py) pins this.
 **Observability**: every dispatch records its chosen path in a
 process-global tally; :func:`crdt_tpu.obs.health.sample_union_paths`
 mirrors the tally into each node's scraped registry as the
-``union_path{path=...}`` counter, and silent-truncation refusals are
-tallied the same way (the nemesis soak asserts the truncation tally stays
-zero).
+``union_path{path=...}`` counter, bucket-overflow fallbacks additionally
+tally ``bucket_fallback_sort`` (so the served path stays distinguishable
+from the planned one), and silent-truncation refusals are tallied the
+same way (the nemesis soak asserts the truncation tally stays zero).
 """
 from __future__ import annotations
 
@@ -90,13 +91,19 @@ _TRUNCATION_TALLY = 0
 def record_union_path(path: str, n: int = 1, registry=None) -> None:
     """Count one auto-dispatch decision (``path`` in sort/bucket/bitmap).
     With ``registry`` the counter is ALSO recorded directly as
-    ``union_path{path=...}`` (callers that own a node registry); the
-    process tally feeds the scrape-time sampler either way."""
-    global _PATH_TALLY
-    with _TALLY_LOCK:
-        _PATH_TALLY[path] = _PATH_TALLY.get(path, 0) + n
+    ``union_path{path=...}`` (callers that own a node registry); a direct
+    record advances that registry's ``union_path_sampled`` gauge by the
+    same amount so the scrape-time sampler
+    (crdt_tpu.obs.health.sample_union_paths) does not converge the same
+    event a second time.  The registry is bumped BEFORE the global tally
+    so a concurrent scrape can only under-read (its delta guard skips
+    non-positive deltas), never double-count."""
     if registry is not None:
         registry.inc("union_path", n, path=path)
+        seen = registry.gauge_value("union_path_sampled", path=path) or 0
+        registry.set_gauge("union_path_sampled", seen + n, path=path)
+    with _TALLY_LOCK:
+        _PATH_TALLY[path] = _PATH_TALLY.get(path, 0) + n
 
 
 def union_path_counts() -> Dict[str, int]:
@@ -247,6 +254,13 @@ def bitmap_to_sorted(present: jax.Array, removed: jax.Array, out_size: int):
     negv, idx = jax.lax.top_k(-keysf.T, k)
     keys = (-negv).T
     vals = jnp.take_along_axis(remf.T, idx, axis=1).T
+    if k < out_size:
+        # declared universe smaller than the table: pad the tail exactly
+        # like the sort path's SENTINEL planes so every engine returns
+        # out_size rows (the bit-parity contract)
+        keys = jnp.pad(keys, ((0, out_size - k), (0, 0)),
+                       constant_values=int(SENTINEL))
+        vals = jnp.pad(vals, ((0, out_size - k), (0, 0)))
     return keys, vals, bitmap_count(present)
 
 
@@ -349,7 +363,9 @@ def engine_bucket(keys_a, vals_a, keys_b, vals_b, out_size, *,
     more than Wb keys of a single bucket; ``sorted_to_bucketed`` reports
     those as dropped rows, and this wrapper falls back to the sort path
     (host-side check — this is a boundary wrapper, never traced), keeping
-    the bit-parity contract unconditional."""
+    the bit-parity contract unconditional.  The fallback is tallied as
+    ``bucket_fallback_sort`` so the union_path counter distinguishes the
+    path actually served from the path the dispatcher planned."""
     from crdt_tpu.ops import pallas_union
 
     c = keys_a.shape[0]
@@ -358,6 +374,7 @@ def engine_bucket(keys_a, vals_a, keys_b, vals_b, out_size, *,
     ka, va, da = sorted_to_bucketed(keys_a, vals_a, nb, key_bits)
     kb, vb, db = sorted_to_bucketed(keys_b, vals_b, nb, key_bits)
     if bool(jnp.any(da != 0)) or bool(jnp.any(db != 0)):
+        record_union_path("bucket_fallback_sort")
         return engine_sort(keys_a, vals_a, keys_b, vals_b, out_size,
                            interpret=interpret)
     union = (pallas_union.bucketed_union_columnar if use_kernel
@@ -397,15 +414,30 @@ def dispatch_union(keys_a, vals_a, keys_b, vals_b, out_size, *,
                    interpret: bool = False, registry=None):
     """Plan + record + run one boundary-level union over canonical sorted
     operands.  ``engine="auto"`` consults :func:`plan_union`; a named
-    engine pins the path (still recorded).  Returns
-    (keys, vals, n_unique, path)."""
+    engine pins the path (still recorded), but is validated through the
+    same preconditions plan_union applies — a pin that cannot be served
+    raises a descriptive ValueError instead of dying inside the engine.
+    Returns (keys, vals, n_unique, path)."""
     capacity = keys_a.shape[0]
     if engine == "auto":
         plan = plan_union(capacity, universe=universe)
     else:
+        get_engine(engine)  # unknown names raise before anything tallies
+        if engine == "bitmap" and universe is None:
+            raise ValueError(
+                "engine='bitmap' is pinned but no tag universe was "
+                "declared; pass universe=<dense tag space> or use "
+                "engine='auto'")
+        if engine == "bucket" and (capacity < MIN_BUCKET_CAPACITY
+                                   or capacity & (capacity - 1) != 0):
+            raise ValueError(
+                f"engine='bucket' needs a power-of-two capacity >= "
+                f"{MIN_BUCKET_CAPACITY}, got {capacity}; use "
+                f"engine='auto' for the sort fallback")
         plan = UnionPlan(path=engine, reason="caller-pinned",
                          universe=universe,
-                         n_buckets=max(2, capacity // DEFAULT_BUCKET_ROWS))
+                         n_buckets=(max(2, capacity // DEFAULT_BUCKET_ROWS)
+                                    if engine == "bucket" else None))
     record_union_path(plan.path, registry=registry)
     # only the Pallas-tiled paths need 128-lane alignment; the bitmap
     # engine is plain XLA, and padding it would multiply the O(universe)

@@ -148,6 +148,28 @@ def test_engines_bit_identical_sentinel_edge():
     _assert_identical(_run_all(ka, va, kb, vb, C))
 
 
+def test_bitmap_universe_smaller_than_out_size_pads():
+    """universe < out_size: the bitmap engine must still return out_size
+    planes (SENTINEL keys / zero vals past the universe), bit-identical
+    to the sort path — the shape regression behind auto-dispatched small
+    dense universes."""
+    rng = np.random.default_rng(14)
+    small = 32  # bitmap_words(32) = 1 <= C, so auto picks bitmap
+    ka, va = _mk(rng, 10, space=small)
+    kb, vb = _mk(rng, 10, space=small)
+    sort = pallas_union.sorted_union_columnar(
+        ka, va, kb, vb, out_size=C, interpret=True)
+    bitmap = ue.engine_bitmap(ka, va, kb, vb, C, universe=small)
+    assert bitmap[0].shape == (C, L) and bitmap[1].shape == (C, L)
+    _assert_identical({"sort": sort, "bitmap": bitmap},
+                      _oracle(ka, va, kb, vb, C))
+    keys, vals, _, path = ue.dispatch_union(
+        ka, va, kb, vb, C, engine="auto", universe=small, interpret=True)
+    assert path == "bitmap" and keys.shape == (C, L)
+    np.testing.assert_array_equal(np.asarray(sort[0]), np.asarray(keys))
+    np.testing.assert_array_equal(np.asarray(sort[1]), np.asarray(vals))
+
+
 def test_engines_bit_identical_capacity_boundary():
     """Both operands full: the union truncates (all engines must keep the
     SMALLEST out_size keys and report the pre-truncation count)."""
@@ -263,6 +285,65 @@ def test_record_union_path_direct_registry():
     reg = MetricsRegistry()
     ue.record_union_path("bucket", registry=reg)
     assert reg.counter_value("union_path", path="bucket") == 1
+
+
+def test_direct_registry_record_not_double_counted_by_scrape():
+    """A directly-recorded event lands in BOTH the registry and the
+    process tally; the scrape-time sampler must not converge it again."""
+    from crdt_tpu.obs import health
+    from crdt_tpu.obs.registry import MetricsRegistry
+
+    ue.reset_tallies()
+    reg = MetricsRegistry()
+    ue.record_union_path("bucket", registry=reg)
+    health.sample_union_paths(reg)
+    assert reg.counter_value("union_path", path="bucket") == 1
+    # mixed traffic: one direct, one tally-only — scrape adds only the
+    # tally-only delta
+    ue.record_union_path("bucket")
+    ue.record_union_path("bucket", registry=reg)
+    health.sample_union_paths(reg)
+    assert reg.counter_value("union_path", path="bucket") == 3
+
+
+def test_bucket_overflow_fallback_tallies_served_path():
+    """One operand packs > Wb keys into a single bucket, so engine_bucket
+    serves the sort path — and says so on the tally."""
+    ue.reset_tallies()
+    rng = np.random.default_rng(15)
+    # default plan at C=64: 4 buckets of 16 rows over a 31-bit key space;
+    # 20 keys < 4096 all land in bucket 0 -> conversion overflow
+    ka, va = _mk(rng, 20, exact=True)
+    kb, vb = _mk(rng, 20, exact=True)
+    sort = pallas_union.sorted_union_columnar(
+        ka, va, kb, vb, out_size=C, interpret=True)
+    keys, vals, n, path = ue.dispatch_union(ka, va, kb, vb, C,
+                                            engine="bucket", interpret=True)
+    assert path == "bucket"
+    assert ue.union_path_counts() == {"bucket": 1, "bucket_fallback_sort": 1}
+    for ref, got in zip(sort, (keys, vals, n)):
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_dispatch_validates_pinned_engine():
+    rng = np.random.default_rng(16)
+    ka, va = _mk(rng, 5)
+    kb, vb = _mk(rng, 5)
+    with pytest.raises(ValueError, match="universe"):
+        ue.dispatch_union(ka, va, kb, vb, C, engine="bitmap")
+    with pytest.raises(KeyError, match="unknown union engine"):
+        ue.dispatch_union(ka, va, kb, vb, C, engine="radix")
+    # capacity 96: not a power of two -> descriptive refusal, not a
+    # trace-time AssertionError inside bucket_shift
+    k96 = jnp.full((96, 8), SENTINEL_PY, jnp.int32)
+    v96 = jnp.zeros((96, 8), jnp.int32)
+    with pytest.raises(ValueError, match="power-of-two"):
+        ue.dispatch_union(k96, v96, k96, v96, 96, engine="bucket")
+    # capacity 32: below the bucketed minimum
+    k32 = jnp.full((32, 8), SENTINEL_PY, jnp.int32)
+    v32 = jnp.zeros((32, 8), jnp.int32)
+    with pytest.raises(ValueError, match="power-of-two"):
+        ue.dispatch_union(k32, v32, k32, v32, 32, engine="bucket")
 
 
 # ---- pack hardening + strict joins ------------------------------------------
