@@ -1,0 +1,250 @@
+"""Shard-count scaling of keyspace ingest — the million-key tier's
+throughput story, measured.
+
+Per-dispatch ingest cost scales with PLANE CAPACITY (the jitted merge
+walks capacity-sized planes, not just the batch).  The sharded keyspace
+(crdt_tpu.keyspace) carves one K-slot tenant universe into S independent
+shards of K/S slots each, so a batch that lands whole in its owning
+shard costs a K/S-sized dispatch instead of a K-sized one.  Every arm
+drives N/B full dispatches at the SAME batch size (plus at most one
+partial tail per shard run, reported per row) — only the per-shard
+capacity changes — so the wall-clock ratio isolates the capacity term:
+near-linear throughput in S until fixed dispatch overhead dominates.
+On CPU jax the capacity term measures ~1.1 us/slot against a ~1 ms
+fixed dispatch floor, so the gate needs K/S well above ~4K slots —
+exactly the regime the million-key tier runs in.
+
+The client is shard-aligned, which is the system's intended write path:
+rendezvous routing is deterministic across processes (the routing
+property tests pin this), so a producer partitions its stream with the
+same hash the server uses — the keyspace analogue of partition-aware
+producers — and each admitted group drains as ONE dispatch into ONE
+shard.  A shard-oblivious client still converges identically; it just
+pays splits at the door instead of at the producer.
+
+Two phases:
+
+* **parity** — one multi-tenant stream through an S=4 keyspace door:
+  per-tenant views must equal the client-side fold exactly, dispatch
+  counts are pinned (N/B, not just reported), and a second, freshly
+  built keyspace fed each shard's gossip payload must converge
+  bit-identical per shard (routing determinism + shard-scoped
+  anti-entropy, end to end).
+* **scaling** — arms S in {1, 2, 4} over a FIXED total capacity K and
+  the identical stream: per-shard capacity K/S, batch size B, N/B
+  dispatches per arm; rep 0 of each arm is an uncounted warm-up that
+  absorbs jit compilation for that arm's K/S shapes.  The gate
+  (--assert-scaling) requires wps_S >= eff * S * wps_1 for S=4.
+
+Methodology (house rules, benches/bench_baseline.py): medians over reps,
+JSON rows on stdout.
+
+Usage:
+  python benches/bench_keyspace.py                        # default shape
+  python benches/bench_keyspace.py --tiny                 # CI smoke
+  python benches/bench_keyspace.py --assert-scaling 0.75  # gate 1->4
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import statistics
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+#: scaling arms: shard counts over one fixed total capacity
+ARMS = (1, 2, 4)
+
+#: parity-phase tenants (the scaling arms use one tenant: isolation is
+#: the soak's oracle, capacity is what this bench isolates)
+TENANTS = ("t-acme", "t-bolt", "t-crab", "t-dune")
+
+
+def _stream(n_ops: int, seed: int, tenants=("bench",)):
+    """Seeded (tenant, key, value) stream over a simulated million-key
+    universe: unique keys (coprime stride walk) so the fold oracle has
+    no LWW ties to model."""
+    import random
+
+    rng = random.Random(seed)
+    out = []
+    for i in range(n_ops):
+        idx = (i * 999_983) % 1_000_000
+        out.append((tenants[rng.randrange(len(tenants))],
+                    f"u{idx:06d}", f"v{idx:06d}"))
+    return out
+
+
+def _fresh_door(n_shards: int, total_capacity: int, batch: int):
+    from crdt_tpu.keyspace import KeyspaceFrontDoor, ShardedKeyspace
+
+    ks = ShardedKeyspace(rid=0, n_shards=n_shards,
+                         capacity=total_capacity // n_shards)
+    # max_batch == the submission group size: every full shard-aligned
+    # group trips the size drain inline on the submitting thread, so the
+    # timed region measures drain cost (one jitted dispatch per group);
+    # the few partial tail groups self-flush on a tight deadline
+    door = KeyspaceFrontDoor(ks, max_batch=batch, flush_deadline_s=0.002)
+    return ks, door
+
+
+def _partition(stream, ks, batch: int):
+    """Client-side shard alignment OUTSIDE the timed region: the same
+    rendezvous hash the server uses splits the stream per shard, then
+    chunks each shard's run into batch-sized admission groups."""
+    runs = {}
+    for tenant, key, value in stream:
+        runs.setdefault((ks.shard_of(tenant, key), tenant),
+                        []).append((key, value))
+    groups = []
+    for (_, tenant), rows in runs.items():
+        for i in range(0, len(rows), batch):
+            groups.append((tenant, dict(rows[i:i + batch])))
+    return groups
+
+
+def _dispatches(ks) -> int:
+    return sum(
+        int(shard.metrics.registry.counter_value("merge_dispatches"))
+        for shard in ks.shards)
+
+
+def _run_arm(groups, n_shards: int, total_capacity: int, batch: int):
+    ks, door = _fresh_door(n_shards, total_capacity, batch)
+    t0 = time.perf_counter()
+    for tenant, cmd in groups:
+        door.admit_cmd(tenant, cmd, timeout=30.0)
+    wall = time.perf_counter() - t0
+    return ks, wall
+
+
+def _check_parity(stream, total_capacity: int, batch: int) -> int:
+    """S=4 parity: per-tenant fold equality, pinned dispatch count, and
+    bit-identical per-shard convergence into a second keyspace."""
+    n_shards = 4
+    ks, door = _fresh_door(n_shards, total_capacity, batch)
+    expected = {t: {} for t in TENANTS}
+    for tenant, key, value in stream:
+        expected[tenant][key] = value
+    groups = _partition(stream, ks, batch)
+    for tenant, cmd in groups:
+        idents = door.admit_cmd(tenant, cmd, timeout=30.0)
+        assert all(i is not None for i in idents), "lost idents"
+    for tenant in TENANTS:
+        got = ks.tenant_state(tenant)
+        assert got == expected[tenant], (
+            f"tenant {tenant!r} view != client fold: "
+            f"missing={sorted(set(expected[tenant]) - set(got))[:5]} "
+            f"extra={sorted(set(got) - set(expected[tenant]))[:5]}")
+    n_groups = len(groups)
+    assert _dispatches(ks) == n_groups, (
+        f"{_dispatches(ks)} dispatches for {n_groups} shard-aligned "
+        "groups: drain fusion broken")
+    # shard-scoped anti-entropy into a freshly built twin: routing
+    # determinism means shard i's payload rebuilds shard i exactly
+    from crdt_tpu.keyspace import ShardedKeyspace
+
+    twin = ShardedKeyspace(rid=0, n_shards=n_shards,
+                           capacity=total_capacity // n_shards)
+    for i in range(n_shards):
+        twin.receive(i, ks.gossip_payload(i, None))
+        assert twin.shards[i].get_state() == ks.shards[i].get_state(), (
+            f"shard {i} state diverged after full-payload receive")
+        assert (twin.shards[i].version_vector()
+                == ks.shards[i].version_vector()), (
+            f"shard {i} vv diverged after full-payload receive")
+    return n_groups
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--n-ops", type=int, default=8_192,
+                    help="scaling-phase stream length (all arms)")
+    ap.add_argument("--capacity", type=int, default=65_536,
+                    help="TOTAL keyspace capacity, split across shards")
+    ap.add_argument("--batch", type=int, default=128,
+                    help="shard-aligned admission group size")
+    ap.add_argument("--n-parity", type=int, default=2_048,
+                    help="parity-phase stream length")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="measured reps per arm (plus one warm-up)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tiny", action="store_true",
+                    help="CI smoke: 2K-op arms over 64K total capacity")
+    ap.add_argument("--assert-scaling", type=float, nargs="?",
+                    const=0.75, default=None, metavar="EFF",
+                    help="exit nonzero unless the 4-shard arm reaches "
+                         "EFF x ideal (wps_4 >= EFF * 4 * wps_1); "
+                         "default EFF 0.75")
+    args = ap.parse_args()
+    if args.tiny:
+        # total capacity stays HIGH even in tiny mode: the scaling
+        # signal lives in the capacity term, and shrinking K below
+        # ~16K/shard drowns it in the fixed dispatch floor
+        args.n_ops, args.capacity, args.batch = 2_048, 65_536, 64
+        args.n_parity, args.reps = 512, 2
+
+    rows = []
+
+    # ---- phase 1: parity (fold equality, pinned dispatches, twin) ----
+    parity_stream = _stream(args.n_parity, args.seed, tenants=TENANTS)
+    n_groups = _check_parity(parity_stream, args.capacity, args.batch)
+    rows.append({"phase": "parity", "n_ops": args.n_parity,
+                 "n_shards": 4, "groups": n_groups,
+                 "fold_exact": True, "twin_bit_identical": True})
+
+    # ---- phase 2: scaling over a fixed total capacity ----
+    stream = _stream(args.n_ops, args.seed)
+    assert args.n_ops % args.batch == 0, "n_ops must divide by batch"
+    walls = {}
+    for n_shards in ARMS:
+        # partition against a throwaway keyspace (routing depends only
+        # on the shard count, so any same-S instance agrees)
+        ks0, _ = _fresh_door(n_shards, args.capacity, args.batch)
+        groups = _partition(stream, ks0, args.batch)
+        arm_walls = []
+        for rep in range(args.reps + 1):  # rep 0 = uncounted warm-up
+            ks, wall = _run_arm(groups, n_shards, args.capacity,
+                                args.batch)
+            assert _dispatches(ks) == len(groups), (
+                f"S={n_shards}: {_dispatches(ks)} dispatches for "
+                f"{len(groups)} groups")
+            total_keys = sum(st["keys"] for st in ks.shard_stats())
+            assert total_keys == len({k for _, k, _ in stream}), (
+                f"S={n_shards}: {total_keys} keys materialized")
+            if rep == 0:
+                continue
+            arm_walls.append(wall)
+            rows.append({"phase": "scaling", "n_shards": n_shards,
+                         "rep": rep, "wall_s": round(wall, 4),
+                         "dispatches": len(groups),
+                         "shard_capacity": args.capacity // n_shards})
+        walls[n_shards] = statistics.median(arm_walls)
+
+    wps = {s: args.n_ops / walls[s] for s in ARMS}
+    eff = {s: wps[s] / (s * wps[1]) for s in ARMS}
+    summary = {
+        "bench": "keyspace",
+        "n_ops": args.n_ops, "total_capacity": args.capacity,
+        "batch": args.batch, "reps": args.reps,
+        **{f"wall_s{s}_median_s": round(walls[s], 4) for s in ARMS},
+        **{f"writes_per_s_s{s}": round(wps[s]) for s in ARMS},
+        **{f"scaling_eff_s{s}": round(eff[s], 3) for s in ARMS},
+        "speedup_1_to_4": round(wps[4] / wps[1], 2),
+        "parity_exact": True,  # parity phase would have raised
+    }
+    for row in rows:
+        print(json.dumps(row))
+    print(json.dumps(summary))
+    if args.assert_scaling is not None and eff[4] < args.assert_scaling:
+        print(f"FAIL: 4-shard scaling efficiency {eff[4]:.3f} < "
+              f"{args.assert_scaling} x ideal", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

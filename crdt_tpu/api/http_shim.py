@@ -95,6 +95,22 @@ GC barrier (the algebra's idempotence + monotonicity ARE the protocol):
   POST /composite/upd           {"key": str, "delta": int} -> {"value"}
   POST /composite/rem           {"key": str} -> {"removed": bool}
 
+Sharded keyspace surface (crdt_tpu.keyspace; present only with ``admin``
+whose config enables keyspace_shards > 0).  Writes name their tenant in
+the X-CRDT-Tenant request header: /data, /ingest/page and /map/upd with
+the header route through the tenant door (rendezvous-sharded, per-tenant
+quota); without the header they keep the single-plane path:
+  GET  /ks/gossip?shard=i[&vv=] one SHARD's delta payload + its
+                                stability summary in the body
+                                ({"payload","vv","frontier"})
+  GET  /ks/data[?tenant=t]      tenant's materialized state, or the
+                                per-shard stats without ?tenant
+  POST /ks/compact              {"shard": i, "frontier": {rid: seq}} ->
+                                fold ONE shard (shard-local GC)
+  POST /admin/ks_pull           {"peer": url?} -> one keyspace pull now
+  POST /admin/ks_gc             one shard-local stability-GC round now
+                                (coordinator)
+
 The /condition route takes the flag as a path segment (also accepted:
 ?alive_status=) — the reference registered the route without the parameter
 binding so every call 500'd (quirk §0.1.7); this shim implements what that
@@ -117,6 +133,7 @@ from crdt_tpu.consistency.session import (
 )
 from crdt_tpu.consistency.stability import STABILITY_HEADER, encode_summary
 from crdt_tpu.ingest import PageFormatError, ShedError
+from crdt_tpu.keyspace import TENANT_HEADER
 from crdt_tpu.obs import health
 from crdt_tpu.obs.trace import TRACE_HEADER
 
@@ -186,6 +203,20 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
             return doors[idx] if doors else None
 
         @property
+        def keyspace(self):
+            """The node's ShardedKeyspace (crdt_tpu.keyspace), or None —
+            /ks/* routes 404 without one."""
+            return getattr(admin, "keyspace", None) \
+                if admin is not None else None
+
+        @property
+        def ks_door(self):
+            """The keyspace front door (tenant-aware admission), or
+            None."""
+            return getattr(admin, "ks_door", None) \
+                if admin is not None else None
+
+        @property
         def consistency(self):
             """The node's ConsistencyPlane (crdt_tpu.consistency), or
             None — /read and /cas 404 without one (a bare LocalCluster
@@ -211,14 +242,17 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
 
         def _send_shed(self, exc: ShedError):
             """429 Too Many Requests + Retry-After: the loud, explicit
-            face of the shed policy (never a silent drop)."""
+            face of the shed policy (never a silent drop).  A tenant
+            quota-slice shed names the tenant so a multi-tenant client
+            can tell ITS throttle from global backpressure."""
+            body = {
+                "shed": True, "lane": exc.lane, "n_ops": exc.n_ops,
+                "retry_after": exc.retry_after_s,
+            }
+            if exc.tenant is not None:
+                body["tenant"] = exc.tenant
             self._send_bytes(
-                429,
-                json.dumps({
-                    "shed": True, "lane": exc.lane, "n_ops": exc.n_ops,
-                    "retry_after": exc.retry_after_s,
-                }).encode(),
-                "application/json",
+                429, json.dumps(body).encode(), "application/json",
                 extra_headers={"Retry-After": f"{exc.retry_after_s:.3f}"},
             )
 
@@ -357,6 +391,52 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 else:
                     self._send(404, "not found")
                 return
+            if parts and parts[0] == "ks" and self.keyspace is not None:
+                ks = self.keyspace
+                if url.path == "/ks/gossip":
+                    if not self.node.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    q = parse_qs(url.query)
+                    try:
+                        shard = int(q.get("shard", [None])[0])
+                        assert 0 <= shard < ks.n_shards
+                    except (TypeError, ValueError, AssertionError):
+                        self._send(400, "invalid shard")
+                        return
+                    since = self._parse_vv_query(url)
+                    if since == "bad":
+                        self._send(400, "invalid vv")
+                        return
+                    payload = ks.gossip_payload(shard, since=since)
+                    # the shard's stability summary rides the BODY: a
+                    # round pulls several shards and the header slot
+                    # holds only one summary (net.RemotePeer)
+                    vv, frontier = ks.vv_snapshot(shard)
+                    self._send(200, json.dumps({
+                        "payload": payload,
+                        "vv": {str(r): s for r, s in vv.items()},
+                        "frontier": {str(r): s
+                                     for r, s in frontier.items()},
+                    }), "application/json")
+                elif url.path == "/ks/data":
+                    if not self.node.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    q = parse_qs(url.query)
+                    tenant = q.get("tenant", [None])[0]
+                    if tenant is not None:
+                        self._send(200, json.dumps(
+                            {"tenant": tenant,
+                             "state": ks.tenant_state(tenant)}
+                        ), "application/json")
+                    else:
+                        self._send(200, json.dumps(
+                            {"shards": ks.shard_stats()}
+                        ), "application/json")
+                else:
+                    self._send(404, "not found")
+                return
             if url.path == "/metrics":
                 # Prometheus text exposition: the node's whole registry +
                 # the lattice health gauges, sampled at scrape time (the
@@ -369,6 +449,8 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     ingest=self.ingest,
                     stability=getattr(getattr(admin, "agent", None),
                                       "stability", None),
+                    keyspace=self.keyspace,
+                    ks_door=self.ks_door,
                 )
                 self._send(200, body, PROM_CTYPE)
             elif url.path == "/ping":
@@ -377,6 +459,17 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 else:
                     self._send(502, "Unreachable")
             elif url.path == "/data":
+                tenant = self.headers.get(TENANT_HEADER)
+                if tenant is not None and self.keyspace is not None:
+                    # tenant-scoped read: the tenant's slice of the
+                    # keyspace, un-qualified (mirror of the write route)
+                    if not self.node.alive:
+                        self._send(502, "Unreachable")
+                        return
+                    self._send(200,
+                               json.dumps(self.keyspace.tenant_state(tenant)),
+                               "application/json")
+                    return
                 state = self.node.get_state()
                 if state is None:
                     self._send(502, "Unreachable")
@@ -486,13 +579,22 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 if not self.node.alive:
                     self._send(502, "Unreachable")
                     return
+                tenant = self.headers.get(TENANT_HEADER)
                 try:
-                    out = front.admit_page(raw)
+                    if tenant is not None and self.ks_door is not None:
+                        # tenant-scoped page: rendezvous fan-out across
+                        # shard lanes, per-tenant quota, whole-page shed
+                        out = self.ks_door.admit_page(raw, tenant)
+                    else:
+                        out = front.admit_page(raw, tenant=tenant)
                 except PageFormatError as e:
                     # decode-validates-everything: the page is quarantined
                     # whole (counted + black-boxed inside admit_page); a
                     # truncated page is ALWAYS "no page", never "some ops"
                     self._send(400, f"page quarantined: {e}")
+                    return
+                except ValueError as e:  # bad tenant name
+                    self._send(400, str(e))
                     return
                 except ShedError as e:
                     self._send_shed(e)
@@ -577,6 +679,23 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         ok = admin.admin_composite_pull(body.get("peer"))
                         self._send(200, json.dumps({"pulled": bool(ok)}),
                                    "application/json")
+                    elif path == "/admin/ks_pull":
+                        fresh = admin.admin_ks_pull(body.get("peer"))
+                        self._send(200, json.dumps({"fresh": int(fresh)}),
+                                   "application/json")
+                    elif path == "/admin/ks_gc":
+                        folded = admin.admin_ks_gc()
+                        self._send(
+                            200,
+                            json.dumps({
+                                "shards": {
+                                    str(i): {str(r): s
+                                             for r, s in f.items()}
+                                    for i, f in folded.items()
+                                }
+                            }),
+                            "application/json",
+                        )
                     elif path == "/admin/seq_barrier":
                         floor = admin.admin_seq_barrier()
                         self._send(
@@ -713,7 +832,20 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         self._send(400, "invalid delta")
                         return
                     front = self.ingest
-                    if front is not None and front.map is not None:
+                    tenant = self.headers.get(TENANT_HEADER)
+                    if tenant is not None and self.ks_door is not None:
+                        # tenant-scoped map write: books against the
+                        # tenant's quota slice, key lands qualified
+                        try:
+                            ident = self.ks_door.admit_map_upd(
+                                tenant, str(body.get("key", "")), delta)
+                        except ShedError as e:
+                            self._send_shed(e)
+                            return
+                        except ValueError as e:  # bad tenant name
+                            self._send(400, str(e))
+                            return
+                    elif front is not None and front.map is not None:
                         # singleton writes share the page path's admission
                         # queue: one drain = one batched mint (parity with
                         # the direct path pinned in tests/test_ingest.py)
@@ -803,6 +935,29 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                                    "application/json")
                 else:
                     self._send(404, "not found")
+                return
+            if path == "/ks/compact":
+                ks = self.keyspace
+                if ks is None:
+                    self._send(404, "no keyspace tier on this node")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    shard = int(body.get("shard"))
+                    assert 0 <= shard < ks.n_shards
+                    frontier = {
+                        int(r): int(s)
+                        for r, s in (body.get("frontier") or {}).items()
+                    }
+                except Exception:
+                    self._send(400, "invalid shard/frontier")
+                    return
+                if not self.node.alive:
+                    self._send(502, "Unreachable")
+                    return
+                ks.compact_shard(shard, frontier)
+                self._send(200, "OK")
                 return
             if path == "/compact":
                 n = int(self.headers.get("Content-Length", 0))
@@ -899,13 +1054,37 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
             except Exception:
                 self._send(500, "Request body is invalid")  # main.go:179-186
                 return
+            tenant = self.headers.get(TENANT_HEADER)
+            if tenant is not None and self.ks_door is not None:
+                # tenant-scoped write: every pair routes to its owning
+                # shard's lane (all-or-nothing vs the shed policy); the
+                # LAST pair's ident mints the session token, exactly as
+                # the single-plane path does for its one ident
+                try:
+                    idents = self.ks_door.admit_cmd(tenant, cmd)
+                except ShedError as e:
+                    self._send_shed(e)
+                    return
+                except ValueError as e:  # bad tenant name
+                    self._send(400, str(e))
+                    return
+                if idents and all(i is not None for i in idents):
+                    ident = idents[-1]
+                    self._send_bytes(
+                        200, b"Inserted", "text/plain",
+                        extra_headers={SESSION_TOKEN_HEADER: encode_token(
+                            {ident[0]: ident[1]})},
+                    )
+                else:
+                    self._send(502, "Unreachable")
+                return
             front = self.ingest
             if front is not None:
                 # the single-op /data route rides the same admission
                 # queue as op pages: concurrent posters fuse into one
                 # jitted ingest dispatch per drain
                 try:
-                    ident = front.admit_kv(cmd)
+                    ident = front.admit_kv(cmd, tenant=tenant)
                 except ShedError as e:
                     self._send_shed(e)
                     return

@@ -314,6 +314,32 @@ class RemotePeer:
             {"frontier": {str(r): s for r, s in frontier.items()}},
         )
 
+    # ---- sharded keyspace surface (crdt_tpu.keyspace) ----
+
+    def ks_gossip(self, shard: int,
+                  since: Optional[Dict[int, int]] = None,
+                  ) -> Optional[Dict[str, Any]]:
+        """GET /ks/gossip?shard=i[&vv=...]: one SHARD's delta payload
+        plus its stability summary in the response BODY ({"payload",
+        "vv", "frontier"}).  Body, not header: a round pulls several
+        shards and the header slot (take_stability) holds only one
+        summary.  Built on _get, so the nemesis fault plane and the
+        circuit breaker see it like any other pull."""
+        path = f"/ks/gossip?shard={int(shard)}"
+        if since is not None:
+            vv = json.dumps({str(r): s for r, s in since.items()})
+            path += "&vv=" + urllib.parse.quote(vv)
+        return self._parse(self._get(path))
+
+    def ks_compact(self, shard: int, frontier: Dict[int, int]) -> bool:
+        """POST /ks/compact: fold ONE shard at/under ``frontier`` —
+        stability GC gone shard-local."""
+        return self._post(
+            "/ks/compact",
+            {"shard": int(shard),
+             "frontier": {str(r): s for r, s in frontier.items()}},
+        )
+
     def push_payload(self, payload: Dict[str, Any]) -> bool:
         """POST /push: hand the peer a gossip payload to merge NOW —
         the synchronous write-quorum leg of CAS (crdt_tpu.consistency
@@ -510,6 +536,7 @@ class NetworkAgent:
         seq_node=None,
         map_node=None,
         composite_node=None,
+        keyspace=None,
     ):
         self.node = node
         self.set_node = set_node  # optional SetNode sibling: pulled together
@@ -546,6 +573,18 @@ class NetworkAgent:
             max_staleness=self.config.stability_max_staleness_s,
             events=node.events,
         )
+        # sharded keyspace (crdt_tpu.keyspace): one stability tracker PER
+        # SHARD — each shard's frontier is minted and folded on its own,
+        # fed from the summaries riding /ks/gossip response bodies
+        self.keyspace = keyspace
+        self.ks_trackers = [] if keyspace is None else [
+            StabilityTracker(
+                shard, [p.url for p in self.peers],
+                max_staleness=self.config.stability_max_staleness_s,
+                events=node.events,
+            )
+            for shard in keyspace.shards
+        ]
         self._rng = random.Random(self.config.seed if seed is None else seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -580,6 +619,7 @@ class NetworkAgent:
         self.seq_pull(peer)
         self.map_pull(peer)
         self.composite_pull(peer)
+        self.ks_pull(peer)
         return merged
 
     def pull_from(self, peer: RemotePeer) -> bool:
@@ -680,6 +720,7 @@ class NetworkAgent:
             self.set_pull(peer)
             self.seq_pull(peer)
             self.map_pull(peer)
+            self.ks_pull(peer)
         # the composite IS a device lattice: its k payloads fold in one
         # dispatch, keeping the fused round at one dispatch per lattice
         self._composite_pull_fused(responding)
@@ -739,6 +780,78 @@ class NetworkAgent:
         fresh = self._receive_quarantined(qn, payload, "seq_gossip", peer)
         self.metrics.inc("seq_gossip_rounds" if fresh else "seq_gossip_noop")
         return fresh > 0
+
+    def ks_pull(self, peer: RemotePeer) -> int:
+        """One keyspace pull round from ``peer``: every shard's delta,
+        shard-scoped (shard i's payload merges into shard i and nothing
+        else — (rid, seq) spaces collide ACROSS shards by design and
+        must never mix).  Malformed shard payloads are quarantined like
+        KV gossip: that shard's round is skipped loudly, the siblings
+        still pull.  Returns total fresh ops merged."""
+        ks = self.keyspace
+        if ks is None:
+            return 0
+        fresh_total = 0
+        for i, shard in enumerate(ks.shards):
+            since = shard.version_vector() \
+                if self.config.delta_gossip else None
+            body = peer.ks_gossip(i, since)
+            if body is None:
+                self.metrics.inc("net_ks_pull_skips")
+                continue
+            try:
+                payload = body.get("payload")
+                fresh = 0 if payload is None else shard.receive(payload)
+            except (ValueError, KeyError, TypeError) as e:
+                self.metrics.inc("net_ks_quarantined")
+                self.node.events.emit(
+                    "payload_quarantine", surface="ks_gossip",
+                    peer=peer.url, shard=i,
+                    error=f"{type(e).__name__}: {e}")
+                continue
+            fresh_total += fresh
+            try:
+                vv = {int(r): int(s)
+                      for r, s in (body.get("vv") or {}).items()}
+                frontier = {int(r): int(s)
+                            for r, s in (body.get("frontier") or {}).items()}
+            except (ValueError, TypeError):
+                continue  # summary malformed: merge stood, tracker skips
+            self.ks_trackers[i].note(peer.url, vv, frontier)
+        self.metrics.inc("net_ks_pulls")
+        if fresh_total:
+            self.metrics.inc("net_ks_fresh", fresh_total)
+        return fresh_total
+
+    def ks_gc_once(self, step: Optional[int] = None) -> Dict[int, dict]:
+        """One SHARD-LOCAL stability-GC round (coordinator only): each
+        shard's tracker mints its own frontier from the summaries that
+        rode /ks/gossip bodies; shards whose frontier is provable fold
+        locally and push POST /ks/compact to every peer — a stalled
+        shard freezes ALONE, its siblings keep collecting.  Returns
+        {shard: frontier} for the shards that folded."""
+        ks = self.keyspace
+        if ks is None or not self.node.alive:
+            return {}
+        out: Dict[int, dict] = {}
+        for i, tracker in enumerate(self.ks_trackers):
+            frontier = tracker.mint(step=step)
+            if not frontier:
+                self.metrics.inc("ks_gc_skipped")
+                continue
+            ks.compact_shard(i, frontier)
+            for p in self.peers:
+                if not p.backed_off():
+                    p.ks_compact(i, frontier)
+            out[i] = frontier
+        if out:
+            self.metrics.inc("ks_gc_rounds")
+            self.node.events.emit(
+                "ks_gc",
+                shards={str(i): {str(r): s for r, s in f.items()}
+                        for i, f in out.items()},
+            )
+        return out
 
     def start(self) -> None:
         self._stop.clear()
@@ -1121,11 +1234,29 @@ class NodeHost:
             composite_node=self.composite_node, config=self.config,
             events=self.node.events,
         )
+        # the sharded keyspace tier (crdt_tpu.keyspace): S independent
+        # plane shards + the tenant-aware front door over them.  None
+        # when keyspace_shards=0 — the single-plane layout above keeps
+        # serving unchanged.  Shards share the node's metrics/events so
+        # GET /metrics and the black box stay one-stop.
+        from crdt_tpu.keyspace import (keyspace_from_config,
+                                       keyspace_front_door_from_config)
+
+        self.keyspace = keyspace_from_config(
+            rid, self.config, metrics=self.node.metrics,
+            events=self.node.events,
+        )
+        self.ks_door = None if self.keyspace is None else \
+            keyspace_front_door_from_config(
+                self.keyspace, inner=self.ingest, config=self.config,
+                events=self.node.events, node=str(rid),
+            )
         self.nodes = [self.node]  # duck-types as a cluster for the handler
         self.agent = NetworkAgent(
             self.node, peers, self.config, coordinator=coordinator,
             set_node=self.set_node, seq_node=self.seq_node,
             map_node=self.map_node, composite_node=self.composite_node,
+            keyspace=self.keyspace,
         )
         # strong read/CAS coordinator (crdt_tpu.consistency): reads
         # agent.peers LIVE so a harness that swaps the peer list for
@@ -1311,3 +1442,21 @@ class NodeHost:
         {"epochs": ..., "status": "reset"|"noop"|"skipped"}."""
         epochs, status = self.agent.map_reset_once()
         return {"epochs": epochs, "status": status}
+
+    def admin_ks_pull(self, peer_url: Optional[str] = None) -> int:
+        """One keyspace pull round (all shards), now, from ``peer_url``
+        (or a random configured peer); 0 when the tier is disabled."""
+        if self.keyspace is None:
+            return 0
+        if peer_url is None:
+            if not self.agent.peers:
+                return 0
+            peer = self.agent._rng.choice(self.agent.peers)
+        else:
+            peer = RemotePeer(peer_url)
+        return self.agent.ks_pull(peer)
+
+    def admin_ks_gc(self) -> dict:
+        """One shard-local stability-GC round, now (coordinator only):
+        {shard: frontier} for the shards whose frontier was provable."""
+        return self.agent.ks_gc_once()

@@ -31,6 +31,20 @@ and clock skew — then heals and asserts the CRDT laws held:
   summary, summaries under the running-max true vv the driver recorded)
   and after every round no op above a node's adopted frontier may be
   missing from its raw command map — collected means strictly below;
+* **multitenant isolation** (``--multitenant``) — the sharded keyspace
+  tier (crdt_tpu.keyspace) rides the soak: every write names a tenant,
+  routes by rendezvous hash to one of 4 plane shards, and keys are drawn
+  from a simulated million-key universe.  One NOISY tenant holds a tiny
+  quota slice and keeps bursting past it (plus corrupt pages); the soak
+  asserts per-tenant isolation 1:1 in the ledger — every quota shed and
+  page quarantine the noisy client saw appears tenant-labeled in some
+  node's black box (and ONLY the noisy tenant ever sheds), while every
+  other tenant's converged view is bit-exact against the driver's
+  admission ledger on every node.  Shard-scoped anti-entropy
+  (/ks/gossip) crosses the same fault plane as KV gossip; after heal a
+  shard-local stability GC must empty every shard's op log on every
+  node.  Transport faults only: shards are host-resident state (not
+  checkpointed), so crash-amnesia recovery stays the base soak's job;
 * **strong never-stale** (``--strong``) — a ``strong_op`` action mixes
   linearizable reads and CAS (crdt_tpu.consistency.plane) into the fault
   schedule.  Node clocks are re-pinned each step into disjoint ms bands
@@ -59,7 +73,7 @@ import random
 import tempfile
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from crdt_tpu.faults import (
     FaultPlane,
@@ -170,6 +184,14 @@ class NemesisReport:
     strong_unavailable: int = 0
     strong_conflicts: int = 0
     strong_indeterminate: int = 0
+    # --multitenant accounting (client-side; audited 1:1 vs tenant-
+    # labeled events — the per-tenant never-silent contract)
+    mt_tenants: int = 0
+    mt_shards: int = 0
+    mt_keys: int = 0
+    mt_sheds: int = 0
+    mt_shed_ops: int = 0
+    mt_page_quarantines: int = 0
 
     def summary(self) -> str:
         faults = ", ".join(
@@ -201,6 +223,13 @@ class NemesisReport:
             if self.gc_retained_shadow is not None:
                 prop += (f" vs {self.gc_retained_shadow} without GC "
                          f"(bit-equal states)")
+        if self.mt_tenants:
+            prop += (f"; multitenant: {self.mt_tenants} tenants x "
+                     f"{self.mt_shards} shards -> {self.mt_keys} keys, "
+                     f"noisy: {self.mt_sheds} quota sheds "
+                     f"({self.mt_shed_ops} ops), "
+                     f"{self.mt_page_quarantines} corrupt pages, "
+                     f"provenance 1:1; ks gc emptied every shard log")
         if self.strong_ok or self.strong_unavailable:
             prop += (f"; strong: {self.strong_ok} ok, "
                      f"{self.strong_unavailable} unavailable (1:1 events, "
@@ -340,6 +369,18 @@ class NemesisSoak:
     #: strong-mode register pool: shared across all coordinators so CAS
     #: conflicts and cross-node read-after-CAS actually happen
     STRONG_KEYS = ("reg-a", "reg-b", "reg-c")
+    #: multitenant mode: well-behaved tenants (no quota slice — they ride
+    #: the lane mark and must NEVER shed) plus one noisy tenant whose
+    #: tiny quota slice the soak keeps bursting past
+    MT_TENANTS = ("t-acme", "t-bolt", "t-crab")
+    MT_NOISY = "t-noisy"
+    MT_NOISY_QUOTA = 8
+    MT_SHARDS = 4
+    #: simulated key universe: indices walk the million-key space with a
+    #: coprime stride, so every draw is unique (no cross-node LWW ties)
+    #: while keys scatter over the whole routable range
+    MT_UNIVERSE = 1_000_000
+    MT_STRIDE = 999_983
     #: --gc drives one coordinated GC attempt every this many steps —
     #: OUTSIDE the action rng, so the GC-off shadow arm replays the
     #: identical action stream
@@ -352,11 +393,16 @@ class NemesisSoak:
                  composite: bool = False,
                  overload: bool = False,
                  gc: bool = False,
-                 strong: bool = False):
+                 strong: bool = False,
+                 multitenant: bool = False):
         assert nodes >= 2, "nemesis needs a fleet (>= 2 nodes)"
         assert not (strong and overload), (
             "--strong and --overload use disjoint action tables; run them "
             "as separate soaks"
+        )
+        assert not (multitenant and (strong or overload or composite or gc)), (
+            "--multitenant drives its own action table over the keyspace "
+            "tier; run the other modes as separate soaks"
         )
         self.seed = seed
         self.steps = steps
@@ -394,6 +440,28 @@ class NemesisSoak:
         self.sheds_client = 0
         self.shed_ops_client = 0
         self.pages_corrupt_client = 0
+        # multitenant mode: tenant-scoped writes through each host's
+        # keyspace front door (crdt_tpu.keyspace) — per-tenant admission
+        # ledger, unique-key mint counter over the simulated universe,
+        # and the noisy tenant's client-side shed/quarantine counts the
+        # oracle reconciles 1:1 against tenant-labeled events
+        self.multitenant = multitenant
+        self.mt_expected: Dict[str, Dict[str, str]] = {
+            t: {} for t in (*self.MT_TENANTS, self.MT_NOISY)}
+        self.mt_next = 0
+        self.mt_sheds_client = 0
+        self.mt_shed_ops_client = 0
+        self.mt_corrupt_client = 0
+        self.mt_pagers: Dict[str, Any] = {}
+        if multitenant:
+            from crdt_tpu.ingest import PageBuilder
+            # one builder per tenant (origins clear of the slot indices
+            # overload mode uses); no reboots in this mode, so page_seq
+            # watermarks stay monotone for the whole run
+            self.mt_pagers = {
+                t: PageBuilder(origin=1000 + j, page_size=1 << 20)
+                for j, t in enumerate((*self.MT_TENANTS, self.MT_NOISY))
+            }
         # composite mode: the served mapof(pncounter) (api/compositenode)
         # rides every phase — writes mix in composite upd/rem, every edge
         # pull also pulls the composite surface through the SAME faulty
@@ -426,6 +494,16 @@ class NemesisSoak:
             # most timeout/poll times, so a stuck op costs a bounded,
             # replayable number of proxy rounds before its loud 503
             ingest_kw.update(strong_timeout_s=2.0, session_poll_s=0.25)
+        if multitenant:
+            # per-shard plane capacity scaled to the horizon (a step mints
+            # at most ~8 ops across 4 shards, so 4*steps per shard is a
+            # wide margin even under routing imbalance); the noisy tenant
+            # gets a quota slice small enough that its bursts always trip
+            ingest_kw.update(
+                keyspace_shards=self.MT_SHARDS,
+                keyspace_capacity=max(256, 4 * steps),
+                keyspace_tenant_quota={self.MT_NOISY: self.MT_NOISY_QUOTA},
+            )
         self.config = ClusterConfig(
             n_replicas=nodes, seed=seed,
             gossip_period_ms=600_000,  # external drive only (determinism)
@@ -568,6 +646,106 @@ class NemesisSoak:
             self.writes[rid] = last + last_n
             self.report.writes += last + last_n - first
 
+    # ---- --multitenant actions (keyspace tier, transport faults only) ----
+
+    def _mt_key(self) -> str:
+        """One unique key from the simulated million-key universe: the
+        coprime stride walks all 1e6 indices before repeating, so draws
+        never collide (no cross-node LWW ties for the oracle to model)
+        while routing sees the whole hash range."""
+        idx = (self.mt_next * self.MT_STRIDE) % self.MT_UNIVERSE
+        self.mt_next += 1
+        return f"u{idx:06d}"
+
+    def _mt_write(self) -> None:
+        """One well-behaved tenant writes a small dict through a live
+        host's keyspace door (/data form): pairs fan out to their owning
+        shards, admission is all-or-nothing, and every ident must mint —
+        good tenants ride the lane mark and may never shed."""
+        slot = self.rng.choice(self._alive())
+        tenant = self.rng.choice(self.MT_TENANTS)
+        cmd = {}
+        for _ in range(self.rng.randint(1, 4)):
+            k = self._mt_key()
+            cmd[k] = "v" + k
+        idents = slot.host.ks_door.admit_cmd(tenant, cmd, timeout=5.0)
+        assert all(i is not None for i in idents), (
+            f"tenant {tenant!r} write lost idents: {idents}")
+        self.mt_expected[tenant].update(cmd)
+        self.report.writes += len(cmd)
+
+    def _mt_page(self) -> None:
+        """One well-behaved tenant ships a columnar op page: rows fan out
+        to multiple shards but the page admits (or would shed) WHOLE."""
+        slot = self.rng.choice(self._alive())
+        tenant = self.rng.choice(self.MT_TENANTS)
+        pager = self.mt_pagers[tenant]
+        rows = {}
+        for _ in range(self.rng.randint(3, 8)):
+            k = self._mt_key()
+            rows[k] = "v" + k
+            pager.add(k, rows[k])
+        res = slot.host.ks_door.admit_page(pager.flush(), tenant,
+                                           timeout=5.0)
+        assert not res["dup"] and res["admitted"] == len(rows), res
+        self.mt_expected[tenant].update(rows)
+        self.report.writes += len(rows)
+
+    def _mt_noisy(self) -> None:
+        """The noisy tenant: corrupt pages (quarantined whole, tenant-
+        labeled), bursts past its quota slice (shed whole with the
+        tenant-lane label — its neighbors keep writing), and the odd
+        inside-quota write (admitted noisy ops must still converge).
+        Every rejection is client-counted for the 1:1 reconciliation."""
+        from crdt_tpu.faults.transport import corrupt_page_bytes
+        from crdt_tpu.ingest import PageFormatError, ShedError
+        from crdt_tpu.keyspace import TENANT_LANE
+
+        slot = self.rng.choice(self._alive())
+        tenant = self.MT_NOISY
+        pager = self.mt_pagers[tenant]
+        roll = self.rng.random()
+        if roll < 0.35:
+            for _ in range(self.rng.randint(2, 6)):
+                k = self._mt_key()
+                pager.add(k, "v" + k)
+            try:
+                slot.host.ks_door.admit_page(
+                    corrupt_page_bytes(pager.flush(), self.rng), tenant,
+                    timeout=5.0)
+            except PageFormatError:
+                self.mt_corrupt_client += 1
+                return
+            raise AssertionError(
+                "corrupt tenant page was admitted instead of quarantined")
+        if roll < 0.75:
+            # the driver waits every admitted ticket, so the tenant's
+            # pending depth is 0 here — a burst one past the quota slice
+            # deterministically sheds WHOLE at the tenant lane
+            n = self.MT_NOISY_QUOTA + self.rng.randint(1, 4)
+            for _ in range(n):
+                k = self._mt_key()
+                pager.add(k, "v" + k)
+            try:
+                slot.host.ks_door.admit_page(pager.flush(), tenant,
+                                             timeout=5.0)
+            except ShedError as e:
+                assert e.tenant == tenant and e.lane == TENANT_LANE, e
+                self.mt_sheds_client += 1
+                self.mt_shed_ops_client += n
+                return
+            raise AssertionError(
+                "noisy burst above the quota slice was admitted")
+        cmd = {}
+        for _ in range(self.rng.randint(1, 4)):
+            k = self._mt_key()
+            cmd[k] = "v" + k
+        idents = slot.host.ks_door.admit_cmd(tenant, cmd, timeout=5.0)
+        assert all(i is not None for i in idents), (
+            f"inside-quota noisy write lost idents: {idents}")
+        self.mt_expected[tenant].update(cmd)
+        self.report.writes += len(cmd)
+
     def _pull(self) -> None:
         src = self.rng.choice(self._alive())
         dst = self.rng.choice(src.peer_slots)
@@ -582,6 +760,12 @@ class NemesisSoak:
             # the composite rides the same edge through the same faulty
             # transport: its payload crosses the nemesis too
             src.host.agent.composite_pull(t)
+        if self.multitenant:
+            # every shard's delta crosses the same faulty edge; corrupt
+            # /ks/gossip bodies hit the parse-skip path (first-byte flip
+            # breaks the JSON envelope), truncated ones likewise — a
+            # shard round is skipped, never half-merged
+            src.host.agent.ks_pull(t)
 
     def _checkpoint(self) -> None:
         slot = self.rng.choice(self._alive())
@@ -708,6 +892,16 @@ class NemesisSoak:
                 ("write", "pull", "checkpoint", "crash", "reboot",
                  "barrier", "strong_op"),
                 weights=(35, 33, 8, 4, 6, 2, 12),
+            )[0]
+        elif self.multitenant:
+            # transport faults only: keyspace shards are host-resident
+            # state (not checkpointed), so a crash's amnesia would void
+            # the per-tenant admission ledger — crash/recovery coverage
+            # stays the base soak's job, this arm pins routing +
+            # isolation + shard-scoped anti-entropy
+            action = self.rng.choices(
+                ("mt_write", "mt_page", "pull", "mt_noisy"),
+                weights=(30, 15, 35, 20),
             )[0]
         else:
             action = self.rng.choices(
@@ -972,6 +1166,17 @@ class NemesisSoak:
             fps = [s.host.composite_node.fingerprint() for s in self.slots]
             if not all(fp == fps[0] for fp in fps[1:]):
                 return False
+        if self.multitenant:
+            # per-shard convergence IS fleet convergence (deterministic
+            # routing): every shard's (state, vv) must match across nodes
+            for i in range(self.slots[0].host.keyspace.n_shards):
+                views = [(s.host.keyspace.shards[i].get_state(),
+                          s.host.keyspace.shards[i].version_vector())
+                         for s in self.slots]
+                if any(st is None for st, _ in views):
+                    return False
+                if not all(v == views[0] for v in views[1:]):
+                    return False
         return True
 
     def _converge(self, max_rounds: int) -> None:
@@ -985,6 +1190,8 @@ class NemesisSoak:
                     src.host.agent.pull_from(t)
                     if self.composite:
                         src.host.agent.composite_pull(t)
+                    if self.multitenant:
+                        src.host.agent.ks_pull(t)
                 health.sample_peer_circuits(
                     src.host.node.metrics.registry, str(src.slot),
                     src.transports.values(),
@@ -1180,13 +1387,155 @@ class NemesisSoak:
                 "duplicate composite delivery mutated a converged node"
             )
 
+    # ---- --multitenant: per-tenant isolation oracle + shard-local GC ----
+
+    def _check_multitenant_oracle(self) -> None:
+        """Per-tenant isolation, audited 1:1 on the CONVERGED fleet:
+
+        * every tenant's view on every node is bit-exact against the
+          driver's admission ledger (what was admitted converged; what
+          was shed or quarantined left no trace);
+        * the noisy tenant shed ALONE: every ingest_shed event in every
+          black box carries its tenant label and the tenant-lane mark,
+          and the counts (and op totals) match the client's 1:1 — same
+          for corrupt-page quarantines;
+        * shard-scoped join laws: replaying a peer shard's full payload
+          twice into its converged twin mutates nothing.
+        """
+        from crdt_tpu.keyspace import TENANT_LANE
+
+        tenants = (*self.MT_TENANTS, self.MT_NOISY)
+        for s in self.slots:
+            ks = s.host.keyspace
+            for tenant in tenants:
+                got = ks.tenant_state(tenant)
+                want = self.mt_expected[tenant]
+                assert got == want, (
+                    f"slot {s.slot} tenant {tenant!r}: converged view != "
+                    f"admission ledger: "
+                    f"missing={sorted(set(want) - set(got))[:5]} "
+                    f"extra={sorted(set(got) - set(want))[:5]} "
+                    f"wrong={sorted(k for k in set(got) & set(want) if got[k] != want[k])[:5]}"
+                )
+        a, b = self.slots[0].host.keyspace, self.slots[1].host.keyspace
+        for i in range(a.n_shards):
+            snap = (json.dumps(a.shards[i].get_state(), sort_keys=True),
+                    a.shards[i].version_vector())
+            full = b.gossip_payload(i, None)
+            a.receive(i, full)
+            a.receive(i, full)  # duplicate delivery
+            after = (json.dumps(a.shards[i].get_state(), sort_keys=True),
+                     a.shards[i].version_vector())
+            assert after == snap, (
+                f"duplicate shard-{i} delivery mutated a converged "
+                f"keyspace: {snap} -> {after}"
+            )
+        shed_events, quar_events = [], []
+        for s in self.slots:
+            for e in read_jsonl(s.event_log_path):
+                if e.get("event") == "ingest_shed":
+                    shed_events.append(e)
+                elif e.get("event") == "ingest_page_quarantine":
+                    quar_events.append(e)
+        noisy_sheds = [e for e in shed_events
+                       if e.get("tenant") == self.MT_NOISY
+                       and e.get("lane") == TENANT_LANE
+                       and e.get("high_water") == self.MT_NOISY_QUOTA]
+        assert len(shed_events) == len(noisy_sheds), (
+            f"a well-behaved tenant shed: {len(shed_events)} ingest_shed "
+            f"events but only {len(noisy_sheds)} are noisy-tenant quota "
+            f"sheds — isolation broken: "
+            f"{[e for e in shed_events if e not in noisy_sheds][:3]}"
+        )
+        assert len(noisy_sheds) == self.mt_sheds_client, (
+            f"noisy client saw {self.mt_sheds_client} quota sheds but the "
+            f"black boxes recorded {len(noisy_sheds)}"
+        )
+        ops_logged = sum(int(e.get("n_ops", 0)) for e in noisy_sheds)
+        assert ops_logged == self.mt_shed_ops_client, (
+            f"noisy client had {self.mt_shed_ops_client} ops turned away "
+            f"but the black boxes account for {ops_logged}"
+        )
+        noisy_quar = [e for e in quar_events
+                      if e.get("tenant") == self.MT_NOISY]
+        assert len(quar_events) == len(noisy_quar), (
+            f"page quarantine without noisy-tenant provenance: "
+            f"{[e for e in quar_events if e not in noisy_quar][:3]}"
+        )
+        assert len(noisy_quar) == self.mt_corrupt_client, (
+            f"{self.mt_corrupt_client} corrupt pages were sent but "
+            f"{len(noisy_quar)} tenant-labeled quarantine events logged"
+        )
+        # a multitenant soak where the noisy tenant never tripped its
+        # slice (or never corrupted a page) pinned nothing
+        assert self.mt_sheds_client > 0, (
+            "noisy tenant never tripped its quota slice: bursts too small "
+            "or tenant shed policy dead"
+        )
+        assert self.mt_corrupt_client > 0, (
+            "noisy tenant never quarantined a page: corruption arm dead"
+        )
+        total_keys = sum(len(v) for v in self.mt_expected.values())
+        for st in a.shard_stats():
+            if total_keys >= 32:
+                assert st["keys"] > 0, (
+                    f"a shard holds zero keys over a {total_keys}-key "
+                    f"workload: routing never spread — {a.shard_stats()}"
+                )
+        self.report.mt_tenants = len(tenants)
+        self.report.mt_shards = a.n_shards
+        self.report.mt_keys = total_keys
+        self.report.mt_sheds = self.mt_sheds_client
+        self.report.mt_shed_ops = self.mt_shed_ops_client
+        self.report.mt_page_quarantines = self.mt_corrupt_client
+
+    def _mt_gc_final(self) -> None:
+        """Post-heal shard-local stability GC: age the breakers shut with
+        clean rounds (main + keyspace pulls feed every shard tracker a
+        fresh summary from every member), then one coordinator GC round —
+        every shard must mint, each minted frontier IS that shard's
+        converged vv, and every node's every shard op log must empty."""
+        for _ in range(6):  # > breaker backoff cap: every circuit closes
+            self.plane.step += 1
+            for src in self.slots:
+                for dst in src.peer_slots:
+                    t = src.transports[dst]
+                    if not t.backed_off():
+                        src.host.agent.pull_from(t)
+                        src.host.agent.ks_pull(t)
+        coord = self.slots[0]
+        folded = coord.host.agent.ks_gc_once(step=int(self.plane.step))
+        ks = coord.host.keyspace
+        assert len(folded) == ks.n_shards, (
+            f"post-heal keyspace GC folded only {sorted(folded)} of "
+            f"{ks.n_shards} shards (stalled trackers on a converged, "
+            "fully-fresh fleet?)"
+        )
+        for i in range(ks.n_shards):
+            vv = ks.shards[i].version_vector()
+            assert folded[i] == vv, (
+                f"shard {i}: minted frontier {folded[i]} != converged "
+                f"vv {vv}"
+            )
+        for s in self.slots:
+            for i, shard in enumerate(s.host.keyspace.shards):
+                assert len(shard._commands) == 0, (
+                    f"slot {s.slot} shard {i} retains "
+                    f"{len(shard._commands)} raw commands after the "
+                    "full-vv fold"
+                )
+
     def heal_and_check(self, max_rounds: int = 80) -> NemesisReport:
         self.plane.heal()
         for s in self.slots:
             if not s.alive:
                 s.boot()
                 self.report.reboots += 1
-        self._plant_and_recover()
+        if not self.multitenant:
+            # keyspace shards are host-resident (not checkpointed): the
+            # plant's crash would void the per-tenant ledger, and crash
+            # recovery is the base soak's coverage anyway
+            self._plant_and_recover()
         if self.strong:
             # advance every node (including just-rebooted slots, whose
             # _BandClock was born at the plane's current step) into one
@@ -1197,6 +1546,9 @@ class NemesisSoak:
             self._check_strong_recovery()
         if self.gc:
             self._gc_final()
+        if self.multitenant:
+            self._check_multitenant_oracle()
+            self._mt_gc_final()
         self._check_prefix_oracle()
         self._check_idempotence()
         self._check_quarantine_provenance()
@@ -1318,12 +1670,13 @@ def run_soak(seed: int, nodes: int, steps: int,
              composite: bool = False,
              overload: bool = False,
              gc: bool = False,
-             strong: bool = False) -> NemesisReport:
+             strong: bool = False,
+             multitenant: bool = False) -> NemesisReport:
     rep = NemesisSoak(seed, nodes=nodes, steps=steps,
                       fault_log=fault_log, postmortem_dir=postmortem_dir,
                       assemble_check=assemble_check,
                       composite=composite, overload=overload,
-                      gc=gc, strong=strong).run()
+                      gc=gc, strong=strong, multitenant=multitenant).run()
     if gc:
         # shadow arm: the IDENTICAL soak with GC never driven.  The GC
         # drive sits outside the action rng and the fault coins are pure
@@ -1399,6 +1752,14 @@ def main(argv=None) -> int:
                          "strong ops must 503 (never serve stale) during "
                          "quorum loss, match consistency_unavailable "
                          "events 1:1, and recover outright after heal")
+    ap.add_argument("--multitenant", action="store_true",
+                    help="drive a simulated million-key, multi-tenant "
+                         "workload through the sharded keyspace tier: "
+                         "per-tenant views must converge bit-exact to the "
+                         "admission ledger on every node, only the noisy "
+                         "tenant may shed/quarantine (tenant-labeled "
+                         "events 1:1 vs client counts), and post-heal "
+                         "shard-local GC must empty every shard op log")
     ap.add_argument("--race-check", action="store_true",
                     help="run under the witnessed-race detector "
                          "(analysis.verify.race) and fail on any "
@@ -1421,12 +1782,14 @@ def main(argv=None) -> int:
                                assemble_check=args.assemble_check,
                                composite=args.composite,
                                overload=args.overload,
-                               gc=args.gc, strong=args.strong)
+                               gc=args.gc, strong=args.strong,
+                               multitenant=args.multitenant)
                 run_soak(seed, args.nodes, args.steps, fault_log=log_b,
                          postmortem_dir=args.postmortem_dir,
                          composite=args.composite,
                          overload=args.overload,
-                         gc=args.gc, strong=args.strong)
+                         gc=args.gc, strong=args.strong,
+                         multitenant=args.multitenant)
                 a = pathlib.Path(log_a).read_bytes()
                 b = pathlib.Path(log_b).read_bytes()
                 assert a == b, (
@@ -1441,7 +1804,8 @@ def main(argv=None) -> int:
                            assemble_check=args.assemble_check,
                            composite=args.composite,
                            overload=args.overload,
-                           gc=args.gc, strong=args.strong)
+                           gc=args.gc, strong=args.strong,
+                           multitenant=args.multitenant)
             print(f"[nemesis] {rep.summary()}")
         if args.race_check:
             rpt = race.report()

@@ -116,9 +116,14 @@ class AdmissionQueue:
         with self._lock:
             return self._depth
 
-    def submit_many(self, items: Sequence[Any]) -> Ticket:
+    def submit_many(self, items: Sequence[Any],
+                    tenant: Optional[str] = None) -> Ticket:
         """Enqueue a group of ops atomically (one page = one group =
-        all-or-nothing vs the shed policy); returns the group's ticket."""
+        all-or-nothing vs the shed policy); returns the group's ticket.
+        ``tenant`` is provenance only on this lane — it labels the shed
+        counters/event (satellite of the keyspace tier); per-tenant
+        quota SLICES are enforced by the keyspace front door, which
+        tracks per-tenant depth across its lanes."""
         items = list(items)
         if not items:
             t = Ticket(self)
@@ -128,7 +133,8 @@ class AdmissionQueue:
         with self._lock:
             if self.policy.would_shed(self._depth, len(items)):
                 raise self.policy.shed(self.name, len(items), self._depth,
-                                       self.metrics, self.events, self.node)
+                                       self.metrics, self.events, self.node,
+                                       tenant=tenant)
             ticket = Ticket(self)
             self._pending.append((items, ticket, now))
             self._depth += len(items)
@@ -142,8 +148,8 @@ class AdmissionQueue:
             self.flush()
         return ticket
 
-    def submit(self, item: Any) -> Ticket:
-        return self.submit_many([item])
+    def submit(self, item: Any, tenant: Optional[str] = None) -> Ticket:
+        return self.submit_many([item], tenant=tenant)
 
     # ---- drain side ----
 
@@ -269,10 +275,12 @@ class IngestFrontDoor:
     # ---- admission surfaces ----
 
     def admit_kv(self, cmd: Dict[str, str], ts: Optional[int] = None,
-                 timeout: Optional[float] = 30.0):
+                 timeout: Optional[float] = 30.0,
+                 tenant: Optional[str] = None):
         """Single-op /data route: returns the op's (rid, seq) ident, or
-        None when the node is down.  Raises ShedError under overload."""
-        return self.kv.submit((ts, dict(cmd))).wait(timeout)[0]
+        None when the node is down.  Raises ShedError under overload
+        (tenant-labeled when the caller supplied provenance)."""
+        return self.kv.submit((ts, dict(cmd)), tenant=tenant).wait(timeout)[0]
 
     def admit_map_upd(self, key: str, delta: int,
                       timeout: Optional[float] = 30.0):
@@ -286,21 +294,30 @@ class IngestFrontDoor:
             raise RuntimeError("no composite lane on this front door")
         return self.composite.submit((str(key), int(delta))).wait(timeout)[0]
 
-    def admit_page(self, raw: bytes,
-                   timeout: Optional[float] = 30.0) -> Dict[str, Any]:
+    def admit_page(self, raw: bytes, timeout: Optional[float] = 30.0,
+                   tenant: Optional[str] = None) -> Dict[str, Any]:
         """POST /ingest/page: decode + validate (PageFormatError on ANY
         defect — the caller 400s and the page is quarantined whole),
         dedup on (origin, page_seq), then submit every op to the KV lane
-        as one group.  Returns {"admitted", "dup", "page_seq"}."""
+        as one group.  Returns {"admitted", "dup", "page_seq"}.
+        ``tenant`` (the X-CRDT-Tenant header) labels the quarantine/shed
+        provenance — who sent the bad/oversized page, not just how big
+        it was."""
         reg = self.node.metrics.registry
         label = self.kv.node
         reg.inc("ingest_pages", node=label)
         try:
             page = wire.decode_page(raw)
         except wire.PageFormatError:
-            reg.inc("ingest_pages_quarantined", node=label)
+            qlabels = dict(node=label)
+            if tenant is not None:
+                qlabels["tenant"] = tenant
+            reg.inc("ingest_pages_quarantined", **qlabels)
             if self.events is not None:
-                self.events.emit("ingest_page_quarantine", n_bytes=len(raw))
+                ev = dict(n_bytes=len(raw))
+                if tenant is not None:
+                    ev["tenant"] = tenant
+                self.events.emit("ingest_page_quarantine", **ev)
             raise
         with self._wm_lock:
             wm = self._page_watermark.get(page.origin)
@@ -308,7 +325,8 @@ class IngestFrontDoor:
                 reg.inc("ingest_pages_duplicate", node=label)
                 return {"admitted": 0, "dup": True,
                         "page_seq": page.page_seq}
-        ticket = self.kv.submit_many(page.rows())  # ShedError propagates
+        # ShedError propagates (tenant-labeled when provenance is known)
+        ticket = self.kv.submit_many(page.rows(), tenant=tenant)
         with self._wm_lock:
             prev = self._page_watermark.get(page.origin)
             if prev is None or page.page_seq > prev:

@@ -20,23 +20,29 @@ page would break the page's all-or-nothing contract).  Shedding is:
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Mapping, Optional
 
 
 class ShedError(Exception):
     """A submission was rejected by backpressure.  Carries the advisory
-    retry delay the HTTP surface serves as Retry-After (seconds)."""
+    retry delay the HTTP surface serves as Retry-After (seconds).
+    ``tenant`` is set when the triggering mark was a per-tenant quota
+    slice (or when the lane shed a tenant-attributed submission), so
+    provenance survives into the 429 path."""
 
     def __init__(self, lane: str, n_ops: int, depth: int, high_water: int,
-                 retry_after_s: float):
+                 retry_after_s: float, tenant: Optional[str] = None):
         self.lane = lane
         self.n_ops = n_ops
         self.depth = depth
         self.high_water = high_water
         self.retry_after_s = retry_after_s
+        self.tenant = tenant
+        who = f" (tenant {tenant!r})" if tenant is not None else ""
         super().__init__(
-            f"ingest lane {lane!r} over high-water mark: depth {depth} + "
-            f"{n_ops} ops > {high_water}; retry after {retry_after_s}s")
+            f"ingest lane {lane!r}{who} over high-water mark: depth {depth}"
+            f" + {n_ops} ops > {high_water}; retry after {retry_after_s}s")
 
 
 @dataclass(frozen=True)
@@ -47,9 +53,16 @@ class ShedPolicy:
     100-op page counts 100 toward the mark.  ``retry_after_s`` is the
     advisory client backoff — one flush-deadline is enough for a drain
     to clear the queue under normal service, so the default tracks it.
+
+    ``tenant_high_water`` carves per-tenant quota SLICES out of the
+    global mark (crdt_tpu.keyspace): a tenant listed here sheds on its
+    own pending-op count before the lane fills, so one noisy tenant
+    backs off alone while everyone else keeps writing.  Tenants not in
+    the map share the lane mark as before.
     """
     high_water: int = 4096
     retry_after_s: float = 0.05
+    tenant_high_water: Optional[Mapping[str, int]] = field(default=None)
 
     def would_shed(self, depth: int, n_ops: int) -> bool:
         """True when admitting ``n_ops`` more onto ``depth`` pending ops
@@ -57,16 +70,41 @@ class ShedPolicy:
         than the whole mark always sheds (it could never be admitted)."""
         return depth + n_ops > self.high_water
 
+    def tenant_mark(self, tenant: Optional[str]) -> Optional[int]:
+        """The tenant's quota slice, or None when it rides the lane mark."""
+        if tenant is None or not self.tenant_high_water:
+            return None
+        return self.tenant_high_water.get(tenant)
+
+    def would_shed_tenant(self, tenant: Optional[str], tenant_depth: int,
+                          n_ops: int) -> bool:
+        """True when the TENANT's own pending ops would exceed its quota
+        slice (no-op for unlisted tenants — the lane mark still applies
+        through ``would_shed``)."""
+        mark = self.tenant_mark(tenant)
+        return mark is not None and tenant_depth + n_ops > mark
+
     def shed(self, lane: str, n_ops: int, depth: int, metrics, events,
-             node: str) -> ShedError:
+             node: str, tenant: Optional[str] = None,
+             high_water: Optional[int] = None) -> ShedError:
         """Account one shed (counters + black box) and build the error.
         The caller raises it — accounting and control flow stay
-        separable for the drain-side tests."""
+        separable for the drain-side tests.  ``tenant`` adds per-tenant
+        provenance to the counters and the event; ``high_water``
+        overrides the recorded mark (the tenant's quota slice when a
+        slice, not the lane, did the shedding)."""
         reg = metrics.registry
-        reg.inc("ingest_shed", lane=lane, node=node)
-        reg.inc("ingest_shed_ops", float(n_ops), lane=lane, node=node)
+        mark = self.high_water if high_water is None else int(high_water)
+        labels = dict(lane=lane, node=node)
+        if tenant is not None:
+            labels["tenant"] = tenant
+        reg.inc("ingest_shed", **labels)
+        reg.inc("ingest_shed_ops", float(n_ops), **labels)
         if events is not None:
-            events.emit("ingest_shed", lane=lane, n_ops=int(n_ops),
-                        depth=int(depth), high_water=int(self.high_water))
-        return ShedError(lane, n_ops, depth, self.high_water,
-                         self.retry_after_s)
+            ev = dict(lane=lane, n_ops=int(n_ops), depth=int(depth),
+                      high_water=mark)
+            if tenant is not None:
+                ev["tenant"] = tenant
+            events.emit("ingest_shed", **ev)
+        return ShedError(lane, n_ops, depth, mark, self.retry_after_s,
+                         tenant=tenant)

@@ -1,0 +1,27 @@
+"""Sharded multi-tenant keyspace tier (see keyspace/README.md).
+
+``routing`` — rendezvous (HRW) hashing, shared-shaped for reuse;
+``shards`` — S independent CRDT plane shards behind one router;
+``frontdoor`` — per-shard admission lanes with per-tenant quota slices.
+"""
+from crdt_tpu.keyspace.frontdoor import (KeyspaceFrontDoor, TENANT_HEADER,
+                                         TENANT_LANE,
+                                         keyspace_front_door_from_config)
+from crdt_tpu.keyspace.routing import (RendezvousRouter, route_key,
+                                       validate_tenant)
+from crdt_tpu.keyspace.shards import (ShardedKeyspace, keyspace_from_config,
+                                      qualify, split_qualified)
+
+__all__ = [
+    "KeyspaceFrontDoor",
+    "TENANT_HEADER",
+    "RendezvousRouter",
+    "ShardedKeyspace",
+    "TENANT_LANE",
+    "keyspace_from_config",
+    "keyspace_front_door_from_config",
+    "qualify",
+    "route_key",
+    "split_qualified",
+    "validate_tenant",
+]

@@ -1,0 +1,165 @@
+"""The sharded keyspace: S independent CRDT planes behind one router.
+
+One ``ShardedKeyspace`` holds ``n_shards`` full :class:`ReplicaNode`
+planes.  Every tenant-scoped key is owned by exactly one shard —
+``RendezvousRouter`` over the ``shard-0 .. shard-(S-1)`` member list,
+computed identically on every node — so each shard is a self-contained
+CRDT: its own op tensor (capacity ``keyspace_capacity``, growing 2x
+independently), its own interner, its own version vector, and its own
+stability frontier / GC.  No single host structure grows with the TOTAL
+keyspace; a million keys over 64 shards is 64 planes of ~16k keys each.
+
+Interning is two-level: the keyspace interns tenants to small ids (for
+per-tenant accounting tables and gauge labels), and each shard's own
+interner sees only the qualified keys (``tenant:key``) that route to
+it.  The qualified key — not a tenant id — is what's stored and
+gossiped, so the wire stays deterministic across nodes regardless of
+tenant arrival order.
+
+Shards share the host's rid: ``(rid, seq)`` spaces would collide across
+shards, but never meet — gossip is SHARD-SCOPED (``/ks/gossip?shard=i``
+pulls shard i's payload into the peer's shard i and nothing else), and
+shards never merge with each other.  Deterministic routing guarantees
+shard i holds the same key set on every node, so per-shard convergence
+is fleet convergence.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from crdt_tpu.api.node import ReplicaNode
+from crdt_tpu.keyspace.routing import (RendezvousRouter, route_key,
+                                       validate_tenant)
+
+# separates tenant from key in the STORED (and gossiped) qualified key;
+# unambiguous because validate_tenant bans ':' in tenant names
+QUALIFY_SEP = ":"
+
+
+def qualify(tenant: str, key: str) -> str:
+    """The shard-local stored key for ``(tenant, key)``."""
+    return f"{tenant}{QUALIFY_SEP}{key}"
+
+
+def split_qualified(qkey: str) -> Tuple[str, str]:
+    """Inverse of :func:`qualify` (first ``:`` wins — keys may contain
+    more of them)."""
+    tenant, _, key = qkey.partition(QUALIFY_SEP)
+    return tenant, key
+
+
+class ShardedKeyspace:
+    """S independent plane shards + the deterministic router over them."""
+
+    def __init__(self, rid: int, n_shards: int, *, capacity: int = 1024,
+                 metrics=None, events=None, clock=None):
+        n_shards = int(n_shards)
+        if n_shards < 1:
+            raise ValueError(
+                f"ShardedKeyspace needs n_shards >= 1, got {n_shards} "
+                "(use ClusterConfig.keyspace_shards=0 to disable the "
+                "tier instead)")
+        self.rid = int(rid)
+        self.n_shards = n_shards
+        self.router = RendezvousRouter(
+            [f"shard-{i}" for i in range(n_shards)])
+        # shards share the host's metrics/events sinks: merge-dispatch
+        # counters aggregate (what the bench reads) and shard events land
+        # in the same black box
+        self.shards: List[ReplicaNode] = [
+            ReplicaNode(rid=rid, capacity=capacity, metrics=metrics,
+                        clock=clock, events=events)
+            for _ in range(n_shards)
+        ]
+        # level-1 interning: tenant -> small id (accounting only — ids
+        # are NEVER stored or gossiped; arrival order may differ per node)
+        self._tenants: Dict[str, int] = {}
+        self._tenant_lock = threading.Lock()
+
+    # ---- routing & interning ----
+
+    def shard_of(self, tenant: str, key: str) -> int:
+        return self.router.owner_index(route_key(tenant, key))
+
+    def tenant_id(self, tenant: str) -> int:
+        validate_tenant(tenant)
+        with self._tenant_lock:
+            tid = self._tenants.get(tenant)
+            if tid is None:
+                tid = self._tenants[tenant] = len(self._tenants)
+            return tid
+
+    def tenants(self) -> List[str]:
+        with self._tenant_lock:
+            return list(self._tenants)
+
+    # ---- reads ----
+
+    def get(self, tenant: str, key: str) -> Optional[str]:
+        state = self.shards[self.shard_of(tenant, key)].get_state()
+        return None if state is None else state.get(qualify(tenant, key))
+
+    def tenant_state(self, tenant: str) -> Dict[str, str]:
+        """Every live key of one tenant, un-qualified (folds all shards —
+        a tenant's keys spread over the whole ring)."""
+        prefix = tenant + QUALIFY_SEP
+        out: Dict[str, str] = {}
+        for shard in self.shards:
+            for qkey, val in (shard.get_state() or {}).items():
+                if qkey.startswith(prefix):
+                    out[qkey[len(prefix):]] = val
+        return out
+
+    def state(self) -> Dict[str, str]:
+        """The full qualified-key state (shards own disjoint key sets, so
+        a plain union is exact)."""
+        out: Dict[str, str] = {}
+        for shard in self.shards:
+            out.update(shard.get_state() or {})
+        return out
+
+    # ---- anti-entropy (shard-scoped) ----
+
+    def gossip_payload(self, shard: int,
+                       since: Optional[Dict[int, int]] = None):
+        return self.shards[shard].gossip_payload(since=since)
+
+    def receive(self, shard: int, payload: Dict[str, Any]) -> int:
+        return self.shards[shard].receive(payload)
+
+    def version_vector(self, shard: int) -> Dict[int, int]:
+        return self.shards[shard].version_vector()
+
+    def vv_snapshot(self, shard: int):
+        return self.shards[shard].vv_snapshot()
+
+    def compact_shard(self, shard: int, frontier: Dict[int, int]) -> None:
+        """Stability-frontier GC, shard-local: one shard folds without
+        touching its siblings' logs."""
+        self.shards[shard].compact(frontier)
+
+    # ---- accounting ----
+
+    def shard_stats(self) -> List[Dict[str, int]]:
+        """Per-shard {ops: live op-log rows, keys: live keys} — the
+        keyspace_shard_* gauges' source."""
+        out = []
+        for shard in self.shards:
+            out.append({
+                "ops": len(shard._commands),
+                "keys": len(shard.get_state() or {}),
+            })
+        return out
+
+
+def keyspace_from_config(rid: int, config, metrics=None, events=None,
+                         clock=None) -> Optional[ShardedKeyspace]:
+    """Build the tier from ClusterConfig's keyspace knobs; None when
+    disabled (keyspace_shards=0 or a config predating the tier)."""
+    n = int(getattr(config, "keyspace_shards", 0) or 0)
+    if n < 1:
+        return None
+    return ShardedKeyspace(
+        rid, n, capacity=int(getattr(config, "keyspace_capacity", 1024)),
+        metrics=metrics, events=events, clock=clock)

@@ -149,6 +149,30 @@ def sample_ingest(registry, front_door) -> None:
                            lane=lane.name, node=lane.node)
 
 
+def sample_keyspace(registry, node_label: str, keyspace,
+                    ks_door=None) -> None:
+    """Sharded-keyspace gauges (crdt_tpu.keyspace), scrape-fresh:
+    per-shard ``keyspace_shard_ops`` (live op-log rows) and
+    ``keyspace_shard_keys`` (live keys) show routing balance and where
+    the log debt sits; per-shard ``keyspace_shard_depth`` (pending ops
+    in the shard's admission lane) shows which shard is hot RIGHT NOW;
+    per-tenant ``keyspace_tenant_depth`` shows who is filling it.  The
+    companion ``crdt_keyspace_tenant_ops_total`` counter (ops admitted
+    per tenant) is inc'd at drain time by the keyspace door."""
+    for i, stat in enumerate(keyspace.shard_stats()):
+        registry.set_gauge("keyspace_shard_ops", float(stat["ops"]),
+                           shard=str(i), node=node_label)
+        registry.set_gauge("keyspace_shard_keys", float(stat["keys"]),
+                           shard=str(i), node=node_label)
+    if ks_door is not None:
+        for i, lane in enumerate(ks_door.lanes):
+            registry.set_gauge("keyspace_shard_depth", float(lane.depth),
+                               shard=str(i), node=node_label)
+        for tenant, depth in ks_door.tenant_depths().items():
+            registry.set_gauge("keyspace_tenant_depth", float(depth),
+                               tenant=tenant, node=node_label)
+
+
 def sample_peer_circuits(registry, node_label: str, peers) -> None:
     """Partition-state gauges from the NetworkAgent's RemotePeer circuit
     breakers: per-peer breaker state (0 closed / 1 half-open / 2 open),
@@ -239,7 +263,8 @@ def sample_union_paths(registry) -> None:
 
 def sample_all(registry, node, set_node=None, seq_node=None,
                map_node=None, composite_node=None, agent=None,
-               ingest=None, stability=None) -> None:
+               ingest=None, stability=None, keyspace=None,
+               ks_door=None) -> None:
     sample_kv_node(registry, node)
     sample_union_paths(registry)
     if set_node is not None:
@@ -256,15 +281,19 @@ def sample_all(registry, node, set_node=None, seq_node=None,
         sample_ingest(registry, ingest)
     if stability is not None:
         sample_stability(registry, str(node.rid), stability)
+    if keyspace is not None:
+        sample_keyspace(registry, str(node.rid), keyspace, ks_door=ks_door)
 
 
 def render_node_metrics(node, set_node=None, seq_node=None,
                         map_node=None, composite_node=None,
-                        agent=None, ingest=None, stability=None) -> str:
+                        agent=None, ingest=None, stability=None,
+                        keyspace=None, ks_door=None) -> str:
     """The GET /metrics body: sample health gauges into the node's
     registry, then render the whole registry as Prometheus text."""
     registry = node.metrics.registry
     sample_all(registry, node, set_node=set_node, seq_node=seq_node,
                map_node=map_node, composite_node=composite_node,
-               agent=agent, ingest=ingest, stability=stability)
+               agent=agent, ingest=ingest, stability=stability,
+               keyspace=keyspace, ks_door=ks_door)
     return registry.render_prometheus()

@@ -11,7 +11,7 @@ main.go:320 (300 ms bootstrap stagger), main.go:267 (localhost listen).
 from __future__ import annotations
 
 import dataclasses
-from typing import List
+from typing import Dict, List, Optional
 
 
 ALPHABET = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ1234567890"
@@ -110,6 +110,21 @@ class ClusterConfig:
     # advisory Retry-After (seconds) served with a shed
     ingest_retry_after_s: float = 0.05
 
+    # ---- sharded keyspace tier (crdt_tpu.keyspace) ----
+    # number of hash shards (independent CRDT planes) behind the front
+    # door; 0 = tier disabled, the single-plane layout above.  Validated
+    # at construction (__post_init__) like the PR 10 pinned-engine knob:
+    # a bad value fails the boot, not the first million-key write.
+    keyspace_shards: int = 0
+    # per-SHARD op-tensor capacity (each shard grows 2x independently,
+    # like log_capacity does for the single plane); total fleet capacity
+    # is keyspace_shards * keyspace_capacity
+    keyspace_capacity: int = 1024
+    # per-tenant quota slices for ShedPolicy.tenant_high_water: tenants
+    # listed here shed on their OWN pending-op depth before the lane
+    # fills (a noisy tenant backs off alone).  None/{} = no slices.
+    keyspace_tenant_quota: Optional[Dict[str, int]] = None
+
     # ---- consistency plane (crdt_tpu.consistency) ----
     # gossip rounds between stability-GC attempts on the coordinator
     # (replica 0); 0 disables fleet-coordinated GC.  Unlike compact_every
@@ -128,6 +143,34 @@ class ClusterConfig:
     # deadline for a session read's dominance wait, and its poll cadence
     session_wait_s: float = 5.0
     session_poll_s: float = 0.02
+
+    def __post_init__(self) -> None:
+        # keyspace knobs fail the BOOT with a named fix, not the first
+        # million-key write (the PR 10 pinned-engine convention)
+        if int(self.keyspace_shards) < 0:
+            raise ValueError(
+                f"keyspace_shards={self.keyspace_shards} is negative; "
+                "use 0 to disable the keyspace tier or a positive shard "
+                "count")
+        if self.keyspace_shards and int(self.keyspace_capacity) < 1:
+            raise ValueError(
+                f"keyspace_capacity={self.keyspace_capacity} must be a "
+                "positive per-shard op-tensor capacity when "
+                f"keyspace_shards={self.keyspace_shards} enables the tier")
+        if self.keyspace_tenant_quota is not None:
+            if not isinstance(self.keyspace_tenant_quota, dict):
+                kind = type(self.keyspace_tenant_quota).__name__
+                raise ValueError(
+                    "keyspace_tenant_quota must be a {tenant: max "
+                    f"pending ops}} dict, got {kind}")
+            from crdt_tpu.keyspace.routing import validate_tenant
+            for t, q in self.keyspace_tenant_quota.items():
+                validate_tenant(t)
+                if not isinstance(q, int) or isinstance(q, bool) or q < 1:
+                    raise ValueError(
+                        f"keyspace_tenant_quota[{t!r}]={q!r} must be a "
+                        "positive int (max pending ops for the tenant's "
+                        "quota slice)")
 
     def ports(self) -> List[int]:
         return [self.base_port + i for i in range(self.n_replicas)]

@@ -1,0 +1,330 @@
+"""Sharded keyspace tier tests: rendezvous routing PROPERTIES (the
+three the tier leans on — cross-process determinism, balance, minimal
+remap under membership change), two-level key qualification, shard
+routing agreement across independently built keyspaces, shard-scoped
+anti-entropy, and the tenant door's quota-slice isolation + labeled
+shed/quarantine provenance.
+
+The determinism test spawns a REAL subprocess with a different
+PYTHONHASHSEED: rendezvous owners must come out identical, which is
+exactly what builtin hash() would fail (it is salted per process) and
+why routing.py scores with blake2b.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from crdt_tpu.ingest import PageBuilder, PageFormatError, ShedError
+from crdt_tpu.ingest.shed import ShedPolicy
+from crdt_tpu.keyspace import (KeyspaceFrontDoor, ShardedKeyspace,
+                               TENANT_LANE, qualify, route_key,
+                               split_qualified, validate_tenant)
+from crdt_tpu.keyspace.routing import RendezvousRouter
+from crdt_tpu.obs.events import EventLog
+from crdt_tpu.utils.config import ClusterConfig
+
+ROUTING_PY = str(pathlib.Path(__file__).resolve().parent.parent
+                 / "crdt_tpu" / "keyspace" / "routing.py")
+
+
+def _keys(n: int, prefix: str = "u") -> list:
+    return [f"{prefix}{i:06d}" for i in range(n)]
+
+
+# ---- routing properties ----
+
+def test_route_key_unambiguous_and_tenant_validation():
+    # ("ab", "c") vs ("a", "bc") must never alias
+    assert route_key("ab", "c") != route_key("a", "bc")
+    assert validate_tenant("t-acme") == "t-acme"
+    for bad in (None, "", 7, "with:colon", "ctrl\x01char", "nl\nname"):
+        with pytest.raises(ValueError):
+            validate_tenant(bad)
+
+
+def test_rendezvous_deterministic_across_processes():
+    """Owners computed in a subprocess with a DIFFERENT hash seed match
+    this process exactly — routing is a pure function of (members, key),
+    never of interpreter state."""
+    members = [f"shard-{i}" for i in range(5)]
+    keys = _keys(64)
+    local = [RendezvousRouter(members).owner_index(k) for k in keys]
+    # import routing.py by file path: the subprocess pins the hash, not
+    # the package's jax import time
+    code = (
+        "import importlib.util, json, sys\n"
+        f"spec = importlib.util.spec_from_file_location('r', {ROUTING_PY!r})\n"
+        "mod = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(mod)\n"
+        f"r = mod.RendezvousRouter({members!r})\n"
+        f"print(json.dumps([r.owner_index(k) for k in {keys!r}]))\n"
+    )
+    for seed in ("0", "4242"):
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env={"PYTHONHASHSEED": seed, "PATH": "/usr/bin:/bin"},
+            check=True)
+        assert json.loads(out.stdout) == local, f"PYTHONHASHSEED={seed}"
+
+
+def test_rendezvous_balance():
+    """No pathological shard: every member owns ~K/n of a uniform key
+    population (binomial stddev for K=4096, n=4 is ~27 keys; the ±20%
+    band is over 7 sigma)."""
+    n, keys = 4, _keys(4096)
+    router = RendezvousRouter([f"shard-{i}" for i in range(n)])
+    counts = collections.Counter(router.owner(k) for k in keys)
+    ideal = len(keys) / n
+    assert len(counts) == n
+    for member, c in counts.items():
+        assert 0.8 * ideal <= c <= 1.2 * ideal, (
+            f"{member} owns {c} keys (ideal {ideal:.0f})")
+
+
+def test_rendezvous_minimal_remap_on_join():
+    """Adding a member moves ONLY the keys the new member now wins —
+    ~K/(n+1) of them — and every moved key lands on the new member."""
+    keys = _keys(3000)
+    before = RendezvousRouter([f"shard-{i}" for i in range(5)])
+    after = before.with_member("shard-5")
+    moved = [k for k in keys if before.owner(k) != after.owner(k)]
+    assert all(after.owner(k) == "shard-5" for k in moved), (
+        "a key moved between OLD members on join — HRW argmax broken")
+    expected = len(keys) / 6
+    assert 0.7 * expected <= len(moved) <= 1.3 * expected, (
+        f"{len(moved)} keys moved, expected ~{expected:.0f}")
+
+
+def test_rendezvous_minimal_remap_on_leave():
+    """Removing a member moves ONLY its own keys; each falls to its
+    second-ranked member."""
+    keys = _keys(2000)
+    before = RendezvousRouter([f"shard-{i}" for i in range(5)])
+    after = before.without_member("shard-2")
+    for k in keys:
+        owner = before.owner(k)
+        if owner == "shard-2":
+            assert after.owner(k) == before.ranked(k)[1]
+        else:
+            assert after.owner(k) == owner, (
+                f"{k} moved off surviving member {owner}")
+
+
+def test_rendezvous_ranked_and_member_hygiene():
+    router = RendezvousRouter(["a", "b", "c"])
+    for k in _keys(32):
+        ranked = router.ranked(k)
+        assert ranked[0] == router.owner(k)
+        assert sorted(ranked) == ["a", "b", "c"]
+        assert router.ranked(k, 2) == ranked[:2]
+    with pytest.raises(ValueError):
+        RendezvousRouter([])
+    with pytest.raises(ValueError):
+        RendezvousRouter(["a", "a"])
+    with pytest.raises(ValueError):
+        router.without_member("nope")
+
+
+# ---- qualified keys & shard routing ----
+
+def test_qualify_split_roundtrip():
+    for tenant, key in (("t", "k"), ("t-acme", "a:b:c"), ("x", "")):
+        assert split_qualified(qualify(tenant, key)) == (tenant, key)
+
+
+def test_shard_routing_agrees_across_instances():
+    """Two independently built keyspaces (different rids — different
+    NODES) route every tenant key identically: the property that makes
+    per-shard convergence fleet convergence."""
+    a = ShardedKeyspace(rid=0, n_shards=8, capacity=64)
+    b = ShardedKeyspace(rid=3, n_shards=8, capacity=64)
+    for tenant in ("t-acme", "t-bolt"):
+        for key in _keys(128):
+            assert a.shard_of(tenant, key) == b.shard_of(tenant, key)
+
+
+def test_shard_scoped_gossip_converges_and_is_idempotent():
+    ks = ShardedKeyspace(rid=0, n_shards=4, capacity=64)
+    door = KeyspaceFrontDoor(ks, max_batch=8)
+    for i in range(24):
+        assert door.admit_kv("t-acme", f"k{i}", f"v{i}", timeout=5.0)
+    twin = ShardedKeyspace(rid=1, n_shards=4, capacity=64)
+    for i in range(4):
+        payload = ks.gossip_payload(i, None)
+        twin.receive(i, payload)
+        twin.receive(i, payload)  # duplicate delivery: CRDT no-op
+        assert twin.shards[i].get_state() == ks.shards[i].get_state()
+        assert twin.version_vector(i) == ks.version_vector(i)
+    assert twin.tenant_state("t-acme") == {
+        f"k{i}": f"v{i}" for i in range(24)}
+
+
+# ---- tenant door: isolation, quota slices, labeled provenance ----
+
+def test_door_tenant_views_are_disjoint():
+    ks = ShardedKeyspace(rid=0, n_shards=4, capacity=64)
+    door = KeyspaceFrontDoor(ks, max_batch=4)
+    door.admit_cmd("t-acme", {"shared-key": "acme", "a1": "1"}, timeout=5.0)
+    door.admit_cmd("t-bolt", {"shared-key": "bolt", "b1": "2"}, timeout=5.0)
+    assert ks.tenant_state("t-acme") == {"shared-key": "acme", "a1": "1"}
+    assert ks.tenant_state("t-bolt") == {"shared-key": "bolt", "b1": "2"}
+    assert ks.get("t-acme", "shared-key") == "acme"
+    assert ks.get("t-bolt", "shared-key") == "bolt"
+
+
+def test_tenant_quota_shed_is_labeled_and_isolated():
+    """A noisy tenant's burst sheds on ITS quota slice — tenant-labeled
+    counters and black-box event — while a neighbor keeps writing
+    through the very same lanes."""
+    ks = ShardedKeyspace(rid=0, n_shards=2, capacity=64)
+    policy = ShedPolicy(high_water=1024,
+                        tenant_high_water={"t-noisy": 2})
+    events = EventLog(node="0")
+    door = KeyspaceFrontDoor(ks, max_batch=4, policy=policy, node="0",
+                             events=events)
+    with pytest.raises(ShedError) as ei:
+        door.admit_cmd("t-noisy", {f"k{i}": "v" for i in range(3)},
+                       timeout=5.0)
+    err = ei.value
+    assert err.tenant == "t-noisy"
+    assert err.lane == TENANT_LANE
+    assert err.high_water == 2 and err.retry_after_s > 0
+    # the neighbor is untouched by the noisy tenant's shed
+    assert door.admit_kv("t-acme", "k", "v", timeout=5.0) is not None
+    # within-quota noisy writes still land
+    door.admit_cmd("t-noisy", {"k0": "v"}, timeout=5.0)
+    reg = door.metrics.registry
+    assert reg.counter_value("ingest_shed", lane=TENANT_LANE, node="0",
+                             tenant="t-noisy") == 1
+    assert reg.counter_value("ingest_shed_ops", lane=TENANT_LANE,
+                             node="0", tenant="t-noisy") == 3
+    sheds = events.find(event="ingest_shed")
+    assert len(sheds) == 1
+    assert sheds[0]["tenant"] == "t-noisy"
+    assert sheds[0]["lane"] == TENANT_LANE
+    assert sheds[0]["high_water"] == 2
+
+
+def test_page_quarantine_is_tenant_labeled_and_whole():
+    ks = ShardedKeyspace(rid=0, n_shards=2, capacity=64)
+    events = EventLog(node="0")
+    door = KeyspaceFrontDoor(ks, max_batch=8, node="0", events=events)
+    pager = PageBuilder(origin=7, page_size=1 << 16)
+    for i in range(4):
+        pager.add(f"k{i}", "v")
+    raw = bytearray(pager.flush())
+    raw[len(raw) // 2] ^= 0xFF  # corrupt the body: checksum must catch
+    with pytest.raises(PageFormatError):
+        door.admit_page(bytes(raw), "t-acme", timeout=5.0)
+    reg = door.metrics.registry
+    assert reg.counter_value("ingest_pages_quarantined", node="0",
+                             tenant="t-acme") == 1
+    quars = events.find(event="ingest_page_quarantine")
+    assert len(quars) == 1 and quars[0]["tenant"] == "t-acme"
+    # nothing from the poisoned page leaked into any shard
+    assert ks.state() == {}
+
+
+def test_page_admission_fans_out_and_dedups():
+    ks = ShardedKeyspace(rid=0, n_shards=4, capacity=64)
+    door = KeyspaceFrontDoor(ks, max_batch=64, node="0")
+    pager = PageBuilder(origin=7, page_size=1 << 16)
+    for i in range(16):
+        pager.add(f"k{i}", f"v{i}")
+    raw = pager.flush()
+    res = door.admit_page(raw, "t-acme", timeout=5.0)
+    assert res["admitted"] == 16 and not res["dup"]
+    assert res["shards"] > 1, "16 keys should span shards"
+    dup = door.admit_page(raw, "t-acme", timeout=5.0)
+    assert dup["dup"] and dup["admitted"] == 0
+    assert ks.tenant_state("t-acme") == {
+        f"k{i}": f"v{i}" for i in range(16)}
+
+
+# ---- end-to-end: HTTP tenant routing + shard-scoped anti-entropy ----
+
+def test_http_tenant_routing_and_ks_pull():
+    """The wire story in one test: X-CRDT-Tenant routes /data writes
+    through the keyspace door, a quota shed surfaces as a tenant-labeled
+    429, tenant reads come back un-qualified, and agent.ks_pull
+    converges every shard onto the peer."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from crdt_tpu.api.net import NodeHost, RemotePeer
+    from crdt_tpu.keyspace import TENANT_HEADER
+
+    cfg = ClusterConfig(keyspace_shards=2, keyspace_capacity=64,
+                        keyspace_tenant_quota={"t-noisy": 2})
+    a = NodeHost(rid=0, peers=[], config=cfg)
+    b = NodeHost(rid=1, peers=[], config=cfg)
+    threads = []
+    for h in (a, b):
+        t = threading.Thread(target=h._server.serve_forever, daemon=True)
+        t.start()
+        threads.append(t)
+    try:
+        def post(url, body, tenant=None):
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(), method="POST")
+            if tenant is not None:
+                req.add_header(TENANT_HEADER, tenant)
+            return urllib.request.urlopen(req, timeout=5)
+
+        assert post(a.url + "/data", {"k1": "v1", "k2": "v2"},
+                    tenant="t-acme").status == 200
+        # tenant-scoped read mirrors the write route, un-qualified
+        req = urllib.request.Request(a.url + "/data")
+        req.add_header(TENANT_HEADER, "t-acme")
+        got = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert got == {"k1": "v1", "k2": "v2"}
+        # the single plane never saw the tenant write
+        assert a.node.get_state() == {}
+        # quota-slice shed: tenant-labeled 429 with Retry-After
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(a.url + "/data", {f"k{i}": "v" for i in range(3)},
+                 tenant="t-noisy")
+        assert ei.value.code == 429
+        shed = json.loads(ei.value.read())
+        assert shed["tenant"] == "t-noisy" and shed["lane"] == TENANT_LANE
+        assert float(ei.value.headers["Retry-After"]) > 0
+        # /ks/data exposes per-shard occupancy and the tenant slice
+        stats = json.loads(urllib.request.urlopen(
+            a.url + "/ks/data", timeout=5).read())
+        assert len(stats["shards"]) == 2
+        view = json.loads(urllib.request.urlopen(
+            a.url + "/ks/data?tenant=t-acme", timeout=5).read())
+        assert view["state"] == {"k1": "v1", "k2": "v2"}
+        # shard-scoped anti-entropy over real sockets
+        assert b.agent.ks_pull(RemotePeer(a.url)) == 2
+        assert b.keyspace.tenant_state("t-acme") == {"k1": "v1",
+                                                     "k2": "v2"}
+        for i in range(2):
+            assert (b.keyspace.version_vector(i)
+                    == a.keyspace.version_vector(i))
+    finally:
+        for h in (a, b):
+            h._server.shutdown()
+            h._server.server_close()
+
+
+def test_config_keyspace_knobs_validated():
+    ClusterConfig(keyspace_shards=2, keyspace_capacity=64,
+                  keyspace_tenant_quota={"t-acme": 8})
+    with pytest.raises(ValueError):
+        ClusterConfig(keyspace_shards=-1)
+    with pytest.raises(ValueError):
+        ClusterConfig(keyspace_shards=2, keyspace_capacity=0)
+    with pytest.raises(ValueError):
+        ClusterConfig(keyspace_shards=2,
+                      keyspace_tenant_quota={"bad:name": 8})
+    with pytest.raises(ValueError):
+        ClusterConfig(keyspace_shards=2,
+                      keyspace_tenant_quota={"t-acme": 0})
