@@ -105,6 +105,7 @@ def bench_read_levels(n_reads: int, seed: int):
     rows = []
     for level, token in (("eventual", None),
                          ("session", warm_token),
+                         ("bounded", None),
                          ("linearizable", None)):
         walls = []
         for k in keys:

@@ -199,6 +199,7 @@ def run_daemon(args) -> int:
         set_collect_every=args.set_collect_every,
         seq_collect_every=args.seq_collect_every,
         map_reset_every=args.map_reset_every,
+        keyspace_shards=args.keyspace_shards,
     )
     peers = [u for u in (args.peers or "").split(",") if u]
     rid = args.rid
@@ -346,6 +347,11 @@ def main(argv=None) -> int:
                          "gossip round / barrier / fault transition, "
                          "carrying the round's X-CRDT-Trace ID — the "
                          "forensic black box the crash soak reads back)")
+    ap.add_argument("--keyspace-shards", type=int, default=0,
+                    help="daemon: enable the sharded keyspace tier with "
+                         "this many hash shards (0 = single-plane layout); "
+                         "shard planes checkpoint/restore through the "
+                         "same manifest machinery as the KV node")
     ap.add_argument("--platform", choices=["cpu", "tpu", "ambient"],
                     default="cpu",
                     help="JAX backend for the host runtime (default cpu: "

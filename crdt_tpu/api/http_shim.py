@@ -15,21 +15,39 @@ compaction barrier, crdt_tpu.api.net.network_compact):
 
 Consistency plane (crdt_tpu.consistency; /read and /cas present only with
 ``admin`` — they need the NodeHost's ConsistencyPlane):
-  GET  /read?key=k&level=l      l in eventual|session|linearizable; a
-                                session read requires the caller's token
-                                in the X-CRDT-Session-Token request
-                                header.  200 {"key","value","level"};
+  GET  /read?key=k&level=l      l in eventual|session|bounded|
+                                linearizable; a session read requires the
+                                caller's token in the X-CRDT-Session-Token
+                                request header; bounded accepts
+                                &staleness=<Δ ops> (default from config).
+                                200 {"key","value","level"};
                                 503 {"error":"consistency_unavailable",...}
-                                when the level's guarantee cannot be met
-                                (never a silently stale value)
+                                + Retry-After header when the level's
+                                guarantee cannot be met (never a silently
+                                stale value)
   POST /cas                     {"key","expect","update"} (expect null =
-                                key must be absent) -> 200 {"token"},
-                                409 {"conflict":true,"actual"},
+                                key must be absent) OR the multi-key form
+                                {"ops": {key: {"expect","update"}}} (all
+                                keys routed, all-or-nothing) -> 200
+                                {"token"}, 409 {"conflict":true,"actual",
+                                "coordinator","fence"} naming the deciding
+                                coordinator so clients can re-route,
                                 503 as /read ("indeterminate":true once
-                                the write was minted but not quorum-acked)
+                                the write was minted but not quorum-acked).
+                                With a LeaseManager the request routes to
+                                the key's slot coordinator ("hops" in the
+                                body counts forwards taken, bounded).
   POST /push                    {"payload": <gossip payload>} -> merge NOW
                                 ("fresh": n): the synchronous write-quorum
-                                leg of CAS
+                                leg of CAS.  An optional {"fences": {slot:
+                                epoch}} stamp is checked BEFORE the merge:
+                                a stale fence is refused whole — 409
+                                {"fenced":true,"slot","fence"} — so a
+                                zombie coordinator can never commit late
+  POST /lease/grant             {"slot","holder","fence","ttl"} -> one
+                                coordinator-lease vote ({"granted",
+                                "fence","holder"}; a refusal names the
+                                blocking fence/holder)
   POST /data additionally answers with an X-CRDT-Session-Token response
   header (the write's vv watermark, minted from the ingest ticket ident)
   when the node has an ingest front door; every GET /gossip response
@@ -224,20 +242,40 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
             return getattr(admin, "consistency", None) \
                 if admin is not None else None
 
+        @property
+        def leases(self):
+            """The node's LeaseManager (crdt_tpu.consistency.leases),
+            or None — /lease/grant 404s and /push skips fence checks
+            without one."""
+            return getattr(admin, "leases", None) \
+                if admin is not None else None
+
         def _send_unavailable(self, exc: ConsistencyUnavailable):
-            """503 Service Unavailable: the loud face of a strong
-            operation that cannot meet its guarantee — never a silently
-            stale value (paired 1:1 with a consistency_unavailable
-            event by the plane)."""
+            """503 Service Unavailable + Retry-After: the loud face of
+            a strong operation that cannot meet its guarantee — never a
+            silently stale value (paired 1:1 with a
+            consistency_unavailable event by the plane).  The advisory
+            Retry-After mirrors the ingest door's 429s; the body
+            carries every field a forwarding origin needs to RE-RAISE
+            the refusal without re-counting it."""
+            body = {
+                "error": "consistency_unavailable",
+                "reason": exc.reason, "level": exc.level,
+                "op": exc.op, "acks": exc.acks, "quorum": exc.quorum,
+                "indeterminate": exc.indeterminate,
+                "retry_after_s": exc.retry_after_s,
+            }
+            if exc.token:
+                # the minted-but-unacked op identity: a forwarding
+                # origin (and the nemesis prefix oracle) must know WHICH
+                # write is outstanding, and under whose rid it minted
+                body["token"] = {str(r): s for r, s in exc.token.items()}
             self._send_bytes(
                 503,
-                json.dumps({
-                    "error": "consistency_unavailable",
-                    "reason": exc.reason, "level": exc.level,
-                    "op": exc.op, "acks": exc.acks, "quorum": exc.quorum,
-                    "indeterminate": exc.indeterminate,
-                }).encode(),
+                json.dumps(body).encode(),
                 "application/json",
+                extra_headers={
+                    "Retry-After": f"{exc.retry_after_s:.3f}"},
             )
 
         def _send_shed(self, exc: ShedError):
@@ -451,6 +489,7 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                                       "stability", None),
                     keyspace=self.keyspace,
                     ks_door=self.ks_door,
+                    leases=self.leases,
                 )
                 self._send(200, body, PROM_CTYPE)
             elif url.path == "/ping":
@@ -531,8 +570,17 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     self._send(400, "session read requires a valid "
                                     f"{SESSION_TOKEN_HEADER} header")
                     return
+                staleness = None
+                if "staleness" in q:
+                    try:
+                        staleness = int(q["staleness"][0])
+                    except ValueError:
+                        self._send(400, "staleness must be an integer "
+                                        "op budget")
+                        return
                 try:
-                    value = plane.read(key, level=level, token=token)
+                    value = plane.read(key, level=level, token=token,
+                                       staleness=staleness)
                 except ValueError as e:
                     self._send(400, str(e))
                     return
@@ -986,12 +1034,27 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     body = json.loads(self.rfile.read(n) or b"{}")
                     payload = body.get("payload")
                     assert isinstance(payload, dict)
+                    fences = {int(s): int(f)
+                              for s, f in (body.get("fences") or {}).items()}
                 except Exception:
                     self._send(400, "invalid payload")
                     return
                 if not self.node.alive:
                     self._send(502, "Unreachable")
                     return
+                if fences and self.leases is not None:
+                    # fence firewall BEFORE the merge: a push stamped
+                    # with a superseded lease epoch is refused WHOLE —
+                    # the zombie-coordinator commit path ends here
+                    stale = self.leases.check_push_fences(fences)
+                    if stale is not None:
+                        self._send_bytes(
+                            409,
+                            json.dumps({"fenced": True,
+                                        "slot": stale["slot"],
+                                        "fence": stale["fence"]}).encode(),
+                            "application/json")
+                        return
                 try:
                     fresh = self.node.receive(payload)
                 except (ValueError, KeyError, TypeError) as e:
@@ -1000,6 +1063,31 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     return
                 self._send(200, json.dumps({"fresh": fresh}),
                            "application/json")
+                return
+            if path == "/lease/grant":
+                leases = self.leases
+                if leases is None:
+                    self._send(404, "no lease manager on this node")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    slot = int(body["slot"])
+                    holder = str(body["holder"])
+                    fence = int(body["fence"])
+                    ttl = float(body["ttl"])
+                    assert 0 <= slot < leases.n_slots and fence > 0 \
+                        and ttl > 0
+                except Exception:
+                    self._send(400, "invalid grant request: need "
+                                    "slot/holder/fence/ttl")
+                    return
+                if not self.node.alive:
+                    self._send(502, "Unreachable")
+                    return
+                self._send(200, json.dumps(
+                    leases.grant(slot, holder, fence, ttl)
+                ), "application/json")
                 return
             if path == "/cas":
                 plane = self.consistency
@@ -1010,22 +1098,41 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 try:
                     body = json.loads(self.rfile.read(n) or b"{}")
                     assert isinstance(body, dict)
-                    key = str(body["key"])
-                    expect = body.get("expect")
-                    expect = None if expect is None else str(expect)
-                    update = str(body["update"])
+                    if "ops" in body:
+                        # multi-key batch: {"ops": {key: {"expect",
+                        # "update"}}} — every pair checked under one
+                        # view, applied all-or-nothing
+                        assert isinstance(body["ops"], dict) and body["ops"]
+                        ops = {}
+                        for k, ou in body["ops"].items():
+                            e = ou.get("expect")
+                            ops[str(k)] = (None if e is None else str(e),
+                                           str(ou["update"]))
+                    else:
+                        key = str(body["key"])
+                        expect = body.get("expect")
+                        expect = None if expect is None else str(expect)
+                        ops = {key: (expect, str(body["update"]))}
+                    hops = int(body.get("hops", 0))
+                    timeout = body.get("timeout")
+                    timeout = None if timeout is None else float(timeout)
+                    assert hops >= 0
                 except Exception:
-                    self._send(400, "invalid body: need key/update "
+                    self._send(400, "invalid body: need key/update or "
+                                    "ops={key:{expect,update}} "
                                     "(expect null = key must be absent)")
                     return
                 try:
-                    token = plane.cas(key, expect, update)
+                    token = plane.cas_multi(ops, timeout=timeout,
+                                            hops=hops)
                 except CasConflict as e:
                     self._send_bytes(
                         409,
                         json.dumps({
                             "conflict": True, "key": e.key,
                             "expect": e.expect, "actual": e.actual,
+                            "coordinator": e.coordinator,
+                            "fence": e.fence,
                         }).encode(),
                         "application/json",
                     )

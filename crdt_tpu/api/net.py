@@ -149,6 +149,20 @@ class RemotePeer:
                 return False  # this caller IS the half-open probe
             return True  # HALF_OPEN: a probe is already in flight
 
+    def backoff_peek(self) -> bool:
+        """``backed_off()`` without the probe side effect: True while the
+        breaker currently forbids traffic, with NO state transition.
+        Passive observers — lease routing membership, gauges — must use
+        this: ``backed_off()`` admits the observing caller as the single
+        half-open probe, and a caller that checks without then sending
+        wedges the breaker in HALF_OPEN forever."""
+        with self._backoff_lock:
+            if self._state == CIRCUIT_CLOSED:
+                return False
+            if self._state == CIRCUIT_OPEN:
+                return self._now() < self.retry_at
+            return True  # HALF_OPEN: the probe is still in flight
+
     def circuit_state(self) -> str:
         """The breaker's current state name (obs gauge + tests)."""
         with self._backoff_lock:
@@ -348,6 +362,84 @@ class RemotePeer:
         so it crosses the nemesis fault plane and the circuit breaker
         like every other leg."""
         return self._post("/push", {"payload": payload})
+
+    # ---- coordinator-lease surface (crdt_tpu.consistency.leases) ----
+
+    def _post_json(self, path: str, body: dict) -> Optional[Dict[str, Any]]:
+        """POST returning ``{"status": int, "body": parsed-or-None}``, or
+        None on transport failure.  The lease/CAS surfaces need the
+        RESPONSE BODY of non-200 statuses (a grant refusal names the
+        blocking fence; a 409 names the deciding coordinator; a 503
+        carries the coordinator's refusal the origin must re-raise), so
+        _post's bool is not enough.  Same breaker accounting as _post —
+        and the nemesis FaultyTransport overrides this too, so the new
+        legs cross the fault plane like every other."""
+        req = urllib.request.Request(
+            self.url + path,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as res:
+                status, raw = res.status, res.read()
+        except urllib.error.HTTPError as e:
+            self._note_reachable()  # served an error status: peer is UP
+            status, raw = e.code, e.read()
+        except (urllib.error.URLError, OSError):
+            self._note_transport_failure()
+            return None
+        self._note_reachable()
+        try:
+            parsed = json.loads(raw)
+        except ValueError:
+            parsed = None
+        return {"status": status,
+                "body": parsed if isinstance(parsed, dict) else None}
+
+    def lease_grant(self, *, slot: int, holder: str, fence: int,
+                    ttl: float) -> Optional[Dict[str, Any]]:
+        """POST /lease/grant: ask this peer to vote one coordinator
+        lease.  Returns the voter's verdict dict ({"granted", "fence",
+        "holder"}), or None on transport failure (a missing vote, not a
+        refusal — the proposer learns nothing from it)."""
+        got = self._post_json("/lease/grant", {
+            "slot": int(slot), "holder": holder,
+            "fence": int(fence), "ttl": float(ttl),
+        })
+        if got is None or got["body"] is None:
+            return None
+        return got["body"]
+
+    def push_fenced(self, payload: Dict[str, Any],
+                    fences: Dict[int, int]) -> Dict[str, Any]:
+        """POST /push with ``{slot: fence}`` stamps.  Returns
+        ``{"ok": True}`` when the peer checked every stamp and merged;
+        ``{"ok": False, "fenced": True, "slot", "fence"}`` when the peer
+        refused a stale fence (naming its known one, so a zombie
+        coordinator learns it was superseded); ``{"ok": False}`` on
+        transport failure / node down."""
+        got = self._post_json("/push", {
+            "payload": payload,
+            "fences": {str(s): int(f) for s, f in fences.items()},
+        })
+        if got is None:
+            return {"ok": False}
+        if got["status"] == 200:
+            return {"ok": True}
+        body = got["body"] or {}
+        if got["status"] == 409 and body.get("fenced"):
+            return {"ok": False, "fenced": True,
+                    "slot": int(body.get("slot", -1)),
+                    "fence": int(body.get("fence", 0))}
+        return {"ok": False}
+
+    def cas_forward(self, body: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """POST /cas at the routed coordinator (the forwarding leg).
+        Returns {"status", "body"} for the plane to interpret — 200
+        token, 409 conflict, 503 refusal — or None on transport failure
+        (indeterminate: the coordinator may have committed)."""
+        return self._post_json("/cas", body)
 
     # ---- extension-surface probe (shared by /set and /seq clients) ----
 
@@ -1214,6 +1306,31 @@ class NodeHost:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every_s = checkpoint_every_s
         self.restored = False
+        # the sharded keyspace tier (crdt_tpu.keyspace): S independent
+        # plane shards (the tenant-aware front door over them is built
+        # after the ingest door below).  None when keyspace_shards=0 —
+        # the single-plane layout above keeps serving unchanged.  Shards
+        # share the node's metrics/events so GET /metrics and the black
+        # box stay one-stop.  Constructed BEFORE the restore so shard
+        # snapshots land back into the live planes.
+        from crdt_tpu.keyspace import (keyspace_from_config,
+                                       keyspace_front_door_from_config)
+
+        self.keyspace = keyspace_from_config(
+            rid, self.config, metrics=self.node.metrics,
+            events=self.node.events,
+        )
+        # coordinator leases (crdt_tpu.consistency.leases): constructed
+        # before the restore so persisted fence floors land back in it —
+        # a crash-rebooted replica keeps refusing the stale fences it
+        # refused before.  attach() wires the bound URL + live peer list
+        # once the server exists.
+        from crdt_tpu.consistency.leases import LeaseManager
+
+        self.leases = LeaseManager(
+            self.node, n_slots=self.config.lease_slots,
+            duration=self.config.lease_duration_s,
+        )
         if checkpoint_dir:
             from crdt_tpu.utils import checkpoint as ckpt
 
@@ -1223,6 +1340,7 @@ class NodeHost:
                 checkpoint_dir, self.node, set_node=self.set_node,
                 seq_node=self.seq_node, map_node=self.map_node,
                 composite_node=self.composite_node,
+                keyspace=self.keyspace, leases=self.leases,
             )
         # the ingest front door (crdt_tpu.ingest): every HTTP write —
         # single-op routes and op pages alike — rides this host's
@@ -1232,18 +1350,6 @@ class NodeHost:
         self.ingest = front_door_from_config(
             self.node, map_node=self.map_node,
             composite_node=self.composite_node, config=self.config,
-            events=self.node.events,
-        )
-        # the sharded keyspace tier (crdt_tpu.keyspace): S independent
-        # plane shards + the tenant-aware front door over them.  None
-        # when keyspace_shards=0 — the single-plane layout above keeps
-        # serving unchanged.  Shards share the node's metrics/events so
-        # GET /metrics and the black box stay one-stop.
-        from crdt_tpu.keyspace import (keyspace_from_config,
-                                       keyspace_front_door_from_config)
-
-        self.keyspace = keyspace_from_config(
-            rid, self.config, metrics=self.node.metrics,
             events=self.node.events,
         )
         self.ks_door = None if self.keyspace is None else \
@@ -1268,12 +1374,21 @@ class NodeHost:
             strong_timeout=self.config.strong_timeout_s,
             session_timeout=self.config.session_wait_s,
             poll=self.config.session_poll_s,
+            leases=self.leases,
+            forward_hops=self.config.cas_forward_hops,
+            bounded_staleness=self.config.bounded_staleness_ops,
+            retry_after_s=self.config.consistency_retry_after_s,
         )
         self._server = ThreadingHTTPServer(
             (host, port), _make_handler(self, 0, admin=self)
         )
         self.port: int = self._server.server_address[1]
         self.url = f"http://{host}:{self.port}"
+        # late lease wiring: routing needs the bound URL (port may have
+        # been OS-assigned) and reads agent.peers live, so a harness
+        # that swaps in FaultyTransports keeps lease traffic inside the
+        # fault schedule too
+        self.leases.attach(self.url, lambda: self.agent.peers)
         self.node.events.emit(
             "boot", port=self.port, restored=self.restored,
             coordinator=coordinator,
@@ -1363,6 +1478,7 @@ class NodeHost:
             self.checkpoint_dir, self.node, set_node=self.set_node,
             seq_node=self.seq_node, map_node=self.map_node,
             composite_node=self.composite_node,
+            keyspace=self.keyspace, leases=self.leases,
         )
 
     def admin_pull(self, peer_url: Optional[str] = None) -> bool:

@@ -1,5 +1,10 @@
 """Consistency plane: session guarantees, quorum strong reads/CAS, and
 stability-frontier coordinated GC — see crdt_tpu/consistency/README.md."""
+from crdt_tpu.consistency.leases import (
+    LEASE_STATE,
+    LeaseManager,
+    slot_of_key,
+)
 from crdt_tpu.consistency.plane import (
     LEVELS,
     CasConflict,
@@ -23,8 +28,11 @@ from crdt_tpu.consistency.stability import (
 )
 
 __all__ = [
+    "LEASE_STATE",
     "LEVELS",
     "CasConflict",
+    "LeaseManager",
+    "slot_of_key",
     "ConsistencyPlane",
     "ConsistencyUnavailable",
     "SESSION_TOKEN_HEADER",

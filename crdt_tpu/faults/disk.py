@@ -97,7 +97,8 @@ class FaultyDisk:
         self.label = label
 
     def save(self, root: str, node, set_node=None, seq_node=None,
-             map_node=None, composite_node=None) -> Tuple[str, bool]:
+             map_node=None, composite_node=None, keyspace=None,
+             leases=None) -> Tuple[str, bool]:
         """save_node_atomic under the current step's disk faults.
         Returns (snap_dir, torn): ``torn`` means the published snapshot
         was damaged post-write and must NOT be treated as durable by the
@@ -111,6 +112,7 @@ class FaultyDisk:
             snap = ckpt.save_node_atomic(
                 root, node, set_node=set_node, seq_node=seq_node,
                 map_node=map_node, composite_node=composite_node,
+                keyspace=keyspace, leases=leases,
             )
         torn = False
         if "truncate" in faults or "corrupt" in faults:

@@ -127,6 +127,21 @@ class FaultyTransport(RemotePeer):
         self._apply_delay(faults, op)
         return super()._post(path, body)
 
+    def _post_json(self, path: str,
+                   body: dict) -> Optional[Dict[str, Any]]:
+        # the coordinator-lease legs (lease_grant, fenced push, CAS
+        # forwarding) all route through _post_json; _op_of auto-labels
+        # them ("/lease/grant" -> "lease_grant", "/cas" -> "cas",
+        # "/push" -> "push") so schedule rules target them untouched
+        op = _op_of(path)
+        faults = self.plane.decide(self.src, self.dst, op)
+        if "drop" in faults:
+            self.plane.record("drop", src=self.src, dst=self.dst, op=op)
+            self._note_transport_failure()
+            return None
+        self._apply_delay(faults, op)
+        return super()._post_json(path, body)
+
     def _probe_get(self, path: str, flag_attr: str):
         op = _op_of(path)
         faults = self.plane.decide(self.src, self.dst, op)

@@ -87,6 +87,7 @@ from typing import Dict, List, Optional, Tuple
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
 RID_STRIDE = 64
+KS_SHARDS = 2  # every daemon boots the sharded keyspace tier (K-invariants)
 
 
 def _free_ports(n: int) -> List[int]:
@@ -113,6 +114,22 @@ def _http(url: str, method: str = "GET", body: Optional[dict] = None,
             return res.status, res.read()
     except urllib.error.HTTPError as e:
         return e.code, e.read()
+
+
+def _http_hdrs(url: str, method: str = "GET", body: Optional[dict] = None,
+               headers: Optional[Dict[str, str]] = None,
+               timeout: float = 30.0) -> Tuple[int, bytes, Dict[str, str]]:
+    """As _http, but carries request headers out AND response headers back
+    (the keyspace workload needs X-CRDT-Tenant in and the minted ident —
+    riding the session-token response header — out)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as res:
+            return res.status, res.read(), dict(res.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers or {})
 
 
 class Daemon:
@@ -149,6 +166,9 @@ class Daemon:
             "--checkpoint-dir", self.ckpt_dir,
             "--rid-stride", str(RID_STRIDE),
             "--gossip-ms", "600000",  # external drive only (determinism)
+            # sharded keyspace tier: per-shard snapshot sections ride the
+            # same manifest (K-invariants below)
+            "--keyspace-shards", str(KS_SHARDS),
             # per-slot black box: every boot of this slot appends to the
             # same JSONL, so a SIGKILLed incarnation's last rounds are
             # readable post-mortem (crdt_tpu.obs.events.read_jsonl
@@ -236,6 +256,11 @@ class CrashReport:
     map_ops_lost: int = 0
     map_peak_records: int = 0     # peak retained records between resets
     final_map_keys: int = 0
+    ks_writes: int = 0            # tenant-scoped keyspace writes accepted
+    ks_rejected: int = 0          # 502 (down) / 429 (shed) — never lost
+    ks_pulls: int = 0             # fresh ops merged by keyspace pulls
+    ks_ops_lost: int = 0          # crash-lost keyspace ops (vv-filtered)
+    final_ks_keys: int = 0        # qualified keys at heal
     event_lines: int = 0          # JSONL black-box lines across all slots
     event_boots: int = 0          # boot events logged (== fleet incarnations)
 
@@ -260,7 +285,10 @@ class CrashReport:
             f"{self.map_barriers} resets (+{self.map_barriers_noop} noop, "
             f"{self.map_barriers_skipped} skipped), {self.map_ops_lost} "
             f"crash-lost, peak {self.map_peak_records} records, "
-            f"{self.final_map_keys} keys; black box: {self.event_lines} "
+            f"{self.final_map_keys} keys; ks: {self.ks_writes} writes "
+            f"(+{self.ks_rejected} rejected), {self.ks_pulls} pulls, "
+            f"{self.ks_ops_lost} crash-lost, {self.final_ks_keys} keys; "
+            f"black box: {self.event_lines} "
             f"event lines / {self.event_boots} boots"
         )
 
@@ -334,6 +362,21 @@ class CrashSoakRunner:
         self.map_epoch_live: Dict[int, Dict[str, int]] = {}   # M2: as S2
         self.map_epoch_ckpt: Dict[int, Dict[str, int]] = {}
         self.map_keys = [f"m{i}" for i in range(max(3, n_keys // 2))]
+        # keyspace oracle: tenant-scoped writes with daemon-minted idents
+        # (the session-token response header).  Seq spaces are PER SHARD
+        # (shards share the host rid by design), so every record carries
+        # its shard index — computed client-side with the same rendezvous
+        # routing the daemons use, which is exactly the determinism the
+        # K-invariants lean on.
+        self.tenants = ["acme", "globex"]
+        self.ks_ops: List[Tuple[int, int, int, str, str, str]] = []
+        #             (shard, rid, seq, tenant, key, val)
+        self.ks_accepted: Dict[Tuple[int, int], int] = {}  # (rid, shard)
+        self.ks_ckpt_watermark: Dict[Tuple[int, int], int] = {}
+        from crdt_tpu.keyspace.routing import RendezvousRouter, route_key
+        self._ks_router = RendezvousRouter(
+            [f"shard-{i}" for i in range(KS_SHARDS)])
+        self._ks_route_key = route_key
         self.report = CrashReport()
 
     # ---- schedule actions ----
@@ -618,6 +661,63 @@ class CrashSoakRunner:
         else:
             self.report.map_barriers_skipped += 1
 
+    # ---- keyspace actions (K-invariants) ----
+
+    def _ks_write(self) -> None:
+        """One tenant-scoped write through the keyspace front door.  The
+        response's session-token header carries the minted (rid, seq) —
+        per-SHARD seq space, so the oracle records the shard index too."""
+        r = self.report
+        d = self.rng.choice(self.daemons)
+        tenant = self.rng.choice(self.tenants)
+        key = self.rng.choice(self.keys)
+        val = str(self.rng.randint(-20, 20))
+        if not d.running:
+            r.ks_rejected += 1
+            return
+        code, _, hdrs = _http_hdrs(
+            d.url + "/data", "POST", {key: val},
+            headers={"X-CRDT-Tenant": tenant},
+        )
+        if code != 200:
+            # 502 soft-dead / 429 shed: rejected loudly, never lost-after-
+            # accept (I2's bar applies to the keyspace door too)
+            r.ks_rejected += 1
+            return
+        token = json.loads(hdrs["X-CRDT-Session-Token"])
+        (got_rid, got_seq), = ((int(k), int(v)) for k, v in token.items())
+        shard = self._ks_router.owner_index(self._ks_route_key(tenant, key))
+        rid = d.wire_rid
+        seq = self.ks_accepted.get((rid, shard), 0)
+        assert (got_rid, got_seq) == (rid, seq), (
+            f"K1: daemon minted {got_rid}:{got_seq} on shard {shard}, "
+            f"oracle expected {rid}:{seq} (routing or seq divergence)"
+        )
+        self.ks_accepted[(rid, shard)] = seq + 1
+        self.ks_ops.append((shard, rid, seq, tenant, key, val))
+        r.ks_writes += 1
+
+    def _ks_pull(self) -> None:
+        up = self._running()
+        if not up:
+            return
+        d = self.rng.choice(up)
+        peer = self.rng.choice(d.peer_urls)
+        code, body = _http(d.url + "/admin/ks_pull", "POST", {"peer": peer})
+        assert code == 200, f"K3: ks pull 500d: {body!r}"
+        self.report.ks_pulls += json.loads(body)["fresh"]
+
+    def _ks_shard_vv(self, d: Daemon, shard: int) -> Optional[Dict[int, int]]:
+        code, body = _http(d.url + f"/ks/gossip?shard={shard}")
+        if code != 200:
+            return None
+        return {int(k): int(v) for k, v in json.loads(body)["vv"].items()}
+
+    def _ks_tenant_state(self, d: Daemon, tenant: str):
+        code, body, _ = _http_hdrs(d.url + "/data",
+                                   headers={"X-CRDT-Tenant": tenant})
+        return json.loads(body) if code == 200 else None
+
     def _pull(self) -> None:
         up = self._running()
         if not up:
@@ -654,6 +754,9 @@ class CrashSoakRunner:
         self.set_ckpt_watermark[rid] = self.set_accepted_per_boot.get(rid, 0)
         self.seq_ckpt_watermark[rid] = self.seq_accepted_per_boot.get(rid, 0)
         self.map_ckpt_watermark[rid] = self.map_accepted_per_boot.get(rid, 0)
+        for shard in range(KS_SHARDS):
+            self.ks_ckpt_watermark[(rid, shard)] = \
+                self.ks_accepted.get((rid, shard), 0)
         # durable-holder bookkeeping: what THIS snapshot would restore
         f = self._query_floor(d, "/set/vv")
         if f is not None:
@@ -707,16 +810,20 @@ class CrashSoakRunner:
 
     def step(self) -> None:
         x = self.rng.random()
-        if x < 0.16:
+        if x < 0.13:
             self._write()
+        elif x < 0.16:
+            self._ks_write()
         elif x < 0.255:
             self._set_write()
         elif x < 0.35:
             self._seq_write()
         elif x < 0.43:
             self._map_write()
-        elif x < 0.525:
+        elif x < 0.495:
             self._pull()
+        elif x < 0.525:
+            self._ks_pull()
         elif x < 0.575:
             self._set_pull()
         elif x < 0.625:
@@ -797,6 +904,15 @@ class CrashSoakRunner:
                 map_items.append(
                     json.loads(body)["items"] if code == 200 else None
                 )
+            # keyspace convergence: every SHARD's vv agrees (shard-scoped
+            # gossip means per-shard convergence IS fleet convergence) and
+            # every tenant's materialized view agrees
+            ks_views = []
+            for d in self.daemons:
+                ks_views.append((
+                    [self._ks_shard_vv(d, s) for s in range(KS_SHARDS)],
+                    [self._ks_tenant_state(d, t) for t in self.tenants],
+                ))
             if (
                 all(s is not None for s in states)
                 and all(s == states[0] for s in states[1:])
@@ -807,6 +923,9 @@ class CrashSoakRunner:
                 and all(m == seq_items[0] for m in seq_items)
                 and all(v == map_views[0] for v in map_views)
                 and all(m == map_items[0] for m in map_items)
+                and all(None not in vv_list and None not in st_list
+                        for vv_list, st_list in ks_views)
+                and all(v == ks_views[0] for v in ks_views)
             ):
                 break
             assert rounds < max_rounds, f"liveness violated (I3): {states}"
@@ -824,6 +943,9 @@ class CrashSoakRunner:
                     code, body = _http(d.url + "/admin/map_pull", "POST",
                                        {"peer": peer})
                     assert code == 200, f"M3: heal map pull 500d: {body!r}"
+                    code, body = _http(d.url + "/admin/ks_pull", "POST",
+                                       {"peer": peer})
+                    assert code == 200, f"K3: heal ks pull 500d: {body!r}"
             rounds += 1
         r.rounds_to_converge = rounds
 
@@ -1059,6 +1181,50 @@ class CrashSoakRunner:
         )
         r.final_map_keys = len(got_map_items)
 
+        # ---- keyspace invariants (K1) over the converged fleet ----
+        # Same shape as I1, but per SHARD: seq spaces collide across
+        # shards by design, so watermark and fold rules are (rid, shard)-
+        # scoped.  The shard snapshots rode the same manifest as the main
+        # plane, so K1a is the satellite's "per-shard sections restore
+        # verified" claim checked end-to-end, not just at the file layer.
+        ks_vvs = [self._ks_shard_vv(self.daemons[0], s)
+                  for s in range(KS_SHARDS)]
+        assert all(vv is not None for vv in ks_vvs)
+        # K1a: explicitly checkpointed keyspace writes survived
+        for (rid, shard), bar in self.ks_ckpt_watermark.items():
+            assert ks_vvs[shard].get(rid, -1) >= bar - 1, (
+                f"K1a: checkpointed ks ops lost: writer {rid} shard "
+                f"{shard} had {bar}, fleet holds "
+                f"{ks_vvs[shard].get(rid, -1) + 1}"
+            )
+        # K1b: writers never killed after their writes lost nothing
+        for d in self.daemons:
+            rid = d.wire_rid
+            for shard in range(KS_SHARDS):
+                n = self.ks_accepted.get((rid, shard), 0)
+                assert ks_vvs[shard].get(rid, -1) == n - 1, (
+                    f"K1b: live ks writer {rid} shard {shard} accepted "
+                    f"{n}, fleet holds {ks_vvs[shard].get(rid, -1) + 1}"
+                )
+        # K1c: every tenant's converged view == the sum fold of exactly
+        # the vv-surviving tenant ops
+        ks_survived = 0
+        tenant_sums: Dict[str, Dict[str, int]] = {t: {} for t in self.tenants}
+        for shard, rid, seq, tenant, key, val in self.ks_ops:
+            if seq <= ks_vvs[shard].get(rid, -1):
+                ks_survived += 1
+                sums = tenant_sums[tenant]
+                sums[key] = sums.get(key, 0) + int(val)
+        r.ks_ops_lost = len(self.ks_ops) - ks_survived
+        for tenant in self.tenants:
+            want_t = {k: str(v) for k, v in tenant_sums[tenant].items()}
+            got_t = self._ks_tenant_state(self.daemons[0], tenant)
+            assert got_t == want_t, (
+                f"K1c: tenant {tenant} diverged from the surviving-op "
+                f"fold: fleet={got_t} oracle={want_t}"
+            )
+            r.final_ks_keys += len(want_t)
+
         # forensic black box (crdt_tpu.obs.events): every slot's JSONL must
         # have recorded the run — one boot line per incarnation (SIGKILLed
         # boots included: the line is flushed at spawn), so a silent
@@ -1095,6 +1261,14 @@ class CrashSoakRunner:
                        for e in restores), (
                 f"black box: slot {d.slot} restored from an unverified or "
                 f"fallback snapshot on an undamaged disk: {restores}"
+            )
+            # every snapshot in this soak was written WITH the keyspace
+            # tier, so every verified restore must have carried all of
+            # its per-shard sections (a restore that silently skipped
+            # them would still pass the manifest check)
+            assert all(e.get("ks_shards") == KS_SHARDS for e in restores), (
+                f"black box: slot {d.slot} restored snapshots missing "
+                f"keyspace shard sections: {restores}"
             )
             quarantines = [e for e in recs if e.get("event") in
                            ("snapshot_quarantine", "payload_quarantine")]

@@ -184,6 +184,13 @@ class NemesisReport:
     strong_unavailable: int = 0
     strong_conflicts: int = 0
     strong_indeterminate: int = 0
+    # --crash-coordinator accounting (rides --strong): leaseholder kills
+    # mid-CAS, zombie pushes refused by fence, and the fence-decision
+    # audit inputs (cas_commit / cas_fenced_reject event totals)
+    coordinator_crashes: int = 0
+    zombie_attempts: int = 0
+    cas_commits: int = 0
+    fenced_rejects: int = 0
     # --multitenant accounting (client-side; audited 1:1 vs tenant-
     # labeled events — the per-tenant never-silent contract)
     mt_tenants: int = 0
@@ -235,6 +242,12 @@ class NemesisReport:
                      f"{self.strong_unavailable} unavailable (1:1 events, "
                      f"{self.strong_indeterminate} indeterminate), "
                      f"{self.strong_conflicts} cas conflicts, never stale")
+        if self.coordinator_crashes or self.zombie_attempts:
+            prop += (f"; coordinator: {self.coordinator_crashes} "
+                     f"leaseholder crashes, {self.zombie_attempts} zombie "
+                     f"pushes fenced off, {self.cas_commits} fenced "
+                     f"commits / {self.fenced_rejects} rejects "
+                     f"(<=1 decider per (slot, fence))")
         return (
             f"seed {self.seed}: {self.steps} steps x {self.nodes} nodes — "
             f"{self.writes} writes, {self.pulls} pulls ({self.merges} "
@@ -266,6 +279,9 @@ class _Slot:
         self.boots = 0
         self.host = None
         self.transports: Dict[int, FaultyTransport] = {}
+        # strong mode: this incarnation's fake plane clock — the lease
+        # scenarios steer it directly (expiry, zombie skew)
+        self.plane_time: Optional[_PlaneTime] = None
 
     @property
     def event_log_path(self) -> str:
@@ -317,6 +333,8 @@ class _Slot:
             for j, url in zip(self.peer_slots, self.peer_urls)
         }
         self.host.agent.peers = list(self.transports.values())
+        ident = self.soak.member_ident
+        self.host.leases.member_key = lambda u: ident.get(u, u)
         if self.soak.gc or self.soak.strong:
             # the stability tracker's staleness windows age in plane
             # steps (same time base as the breakers), and the consistency
@@ -324,8 +342,12 @@ class _Slot:
             # through sleep() — both replay identically under one seed
             self.host.agent.stability.clock = lambda: float(plane.step)
             ft = _PlaneTime()
+            self.plane_time = ft
             self.host.consistency.clock = ft.now
             self.host.consistency.sleep = ft.sleep
+            # the lease table ages on the same fake clock, so expiry and
+            # zombie-skew scenarios are driven by the soak, not wall time
+            self.host.leases.clock = ft.now
         if self.soak.strong:
             # banded mint timestamps over a constant zero epoch — installed
             # after NodeHost restore (which re-applies the snapshot's
@@ -355,6 +377,7 @@ class _Slot:
                 self.ckpt_dir, h.node, set_node=h.set_node,
                 seq_node=h.seq_node, map_node=h.map_node,
                 composite_node=h.composite_node,
+                keyspace=h.keyspace, leases=h.leases,
             )
         self.host.stop_server()
         self.host.node.events.close()
@@ -394,8 +417,13 @@ class NemesisSoak:
                  overload: bool = False,
                  gc: bool = False,
                  strong: bool = False,
+                 crash_coordinator: bool = False,
                  multitenant: bool = False):
         assert nodes >= 2, "nemesis needs a fleet (>= 2 nodes)"
+        assert strong or not crash_coordinator, (
+            "--crash-coordinator targets the lease plane --strong drives; "
+            "enable --strong (main() implies it for you)"
+        )
         assert not (strong and overload), (
             "--strong and --overload use disjoint action tables; run them "
             "as separate soaks"
@@ -415,6 +443,10 @@ class NemesisSoak:
         # strong mode: linearizable reads + CAS join the action table,
         # with clock pinning making the never-stale audit exact
         self.strong = strong
+        # crash-coordinator mode: leaseholder kills mid-CAS + zombie
+        # handoffs join the strong table; the fence-decision oracle
+        # (<=1 decider per (slot, fence)) gates the heal
+        self.crash_coordinator = crash_coordinator
         # driver-side truth for the --gc summary audit: running pointwise
         # max of every member's vv, sampled at the end of every step (a
         # summary may lag but can never exceed this)
@@ -513,6 +545,14 @@ class NemesisSoak:
         )
         self.rng = random.Random(f"nemesis-soak:{seed}")
         ports = _free_ports(nodes)
+        # lease routing ranks member URLS; with OS-assigned ports the
+        # rendezvous would re-draw coordinators every run and the wire-
+        # call schedule (hence the fault log) would never replay — rank
+        # over stable member names instead
+        self.member_ident = {
+            f"http://127.0.0.1:{p}": f"member-{i}"
+            for i, p in enumerate(ports)
+        }
         self.slots = [
             _Slot(self, i, ports[i],
                   [j for j in range(nodes) if j != i],
@@ -774,6 +814,7 @@ class NemesisSoak:
             slot.ckpt_dir, h.node, set_node=h.set_node,
             seq_node=h.seq_node, map_node=h.map_node,
             composite_node=h.composite_node,
+            keyspace=h.keyspace, leases=h.leases,
         )
         self.report.checkpoints += 1
         if torn:
@@ -805,17 +846,39 @@ class NemesisSoak:
         for s in self._alive():
             s.host.node.clock.band = int(step)
 
-    def _strong_op(self) -> None:
+    def _journal_at(self, rid: int, seq: int, kind: str, key: str,
+                    value: str) -> None:
+        """Journal a strong mint under the identity the PLANE reported.
+        With leases routing CAS to a coordinator, the minting rid is the
+        DECIDER's, not the caller's — the returned session token (or the
+        503's attached token) is the only honest source.  The driver is
+        single-threaded, so every rid's mints arrive here in seq order;
+        the contiguity assert catches any decider the driver missed."""
+        if not self.strong:
+            return
+        entries = self.minted.setdefault(rid, [])
+        assert seq == len(entries), (
+            f"mint journal gap for writer {rid}: plane reported seq "
+            f"{seq} but the journal holds {len(entries)} entries — an "
+            "unjournaled decision slipped past the driver"
+        )
+        self.mint_order += 1
+        entries.append((self.mint_order, kind, key, value))
+
+    def _strong_op(self, slot: Optional["_Slot"] = None,
+                   key: Optional[str] = None,
+                   force_cas: bool = False) -> None:
         """One linearizable read or CAS through a live host's consistency
-        plane (its quorum legs cross the FaultyTransports).  Every outcome
-        feeds the never-stale audit; every ConsistencyUnavailable is
-        counted for the 1:1 event reconciliation after heal."""
+        plane (its quorum legs cross the FaultyTransports; CAS from a
+        non-coordinator FORWARDS to the routed leaseholder).  Every
+        outcome feeds the never-stale audit; every ConsistencyUnavailable
+        is counted for the 1:1 event reconciliation after heal."""
         from crdt_tpu.consistency import CasConflict, ConsistencyUnavailable
 
-        slot = self.rng.choice(self._alive())
+        slot = slot if slot is not None else self.rng.choice(self._alive())
         cons = slot.host.consistency
-        key = self.rng.choice(self.STRONG_KEYS)
-        if self.rng.random() < 0.5:
+        key = key if key is not None else self.rng.choice(self.STRONG_KEYS)
+        if not force_cas and self.rng.random() < 0.5:
             try:
                 val = cons.read(key, level="linearizable")
             except ConsistencyUnavailable:
@@ -827,9 +890,8 @@ class NemesisSoak:
             return
         self.strong_gen += 1
         new = f"g{self.strong_gen}"
-        rid = slot.host.node.rid
         try:
-            cons.cas(key, self.strong_view.get(key), new)
+            token = cons.cas(key, self.strong_view.get(key), new)
         except CasConflict as e:
             # the conflict's ACTUAL rode the same quorum read — audit it
             # like any linearizable result, then adopt it as our view
@@ -840,18 +902,23 @@ class NemesisSoak:
         except ConsistencyUnavailable as e:
             self.report.strong_unavailable += 1
             if e.indeterminate:
-                # minted locally but not quorum-acked: the op may still
-                # land via anti-entropy.  The driver is single-threaded,
-                # so the rid's newest seq IS this op — journal it (it
-                # occupies vv space) and allow its value until the next
-                # committed CAS supersedes it (pinned ts ⇒ later commits
-                # always win LWW).
+                # minted but not quorum-acked: the op may still land via
+                # anti-entropy.  The 503 carries the minted identity when
+                # one exists (it occupies vv space — journal it); a bare
+                # indeterminate means the forward died BEFORE any mint
+                # (transport drop), so there is nothing to journal and
+                # the value can never land.  Either way allow the value
+                # until the next committed CAS supersedes it (pinned ts
+                # ⇒ later commits always win LWW).
                 self.report.strong_indeterminate += 1
                 self.strong_pending.setdefault(key, set()).add(new)
-                self._journal(rid, "strong", key, new)
+                if e.token:
+                    (rid, seq), = e.token.items()
+                    self._journal_at(rid, seq, "strong", key, new)
             return
         self.report.strong_ok += 1
-        self._journal(rid, "strong", key, new)
+        (rid, seq), = token.items()
+        self._journal_at(rid, seq, "strong", key, new)
         self.strong_committed[key] = new
         self.strong_pending[key] = set()
         self.strong_view[key] = new
@@ -868,6 +935,120 @@ class NemesisSoak:
             f"{sorted(x if x is not None else '<absent>' for x in allowed)} "
             f"are linearizable (committed or indeterminate-outstanding)"
         )
+
+    # ---- --crash-coordinator: leaseholder kills + zombie handoffs ----
+
+    def _lease_slot_holder(self, key: str):
+        """(lease slot, acting holder) for a strong register — holder is
+        the live slot whose lease table says 'held and unexpired' for the
+        key's routing slot, or None when nobody currently holds it."""
+        from crdt_tpu.consistency.leases import slot_of_key
+
+        lslot = slot_of_key(key, self.config.lease_slots)
+        holder = next(
+            (s for s in self._alive()
+             if s.host.leases.held_fence(lslot) is not None), None)
+        return lslot, holder
+
+    def _crash_leaseholder(self) -> None:
+        """Kill the acting leaseholder mid-CAS: the decision is minted on
+        the holder (exactly where _cas_decide mints, post-expect-check)
+        but the holder dies before ANY fenced push leg runs.  Strong
+        crashes are fail-stop, so the mint survives on its disk and may
+        land via anti-entropy after reboot — the op is journaled under
+        the holder's rid and allowed as indeterminate-outstanding, never
+        counted committed.  No client saw an ack, so no 503 is counted
+        either (the driver IS the client that died with the call)."""
+        alive = self._alive()
+        if len(alive) < 3:
+            return  # the kill leaves >= 2 carrying the fleet's state
+        key = self.rng.choice(self.STRONG_KEYS)
+        lslot, holder = self._lease_slot_holder(key)
+        if holder is None:
+            # nobody holds the slot yet: spend the step minting a lease
+            # (a CAS routes to the rendezvous coordinator, which acquires)
+            self._strong_op(key=key, force_cas=True)
+            return
+        h = holder.host
+        rid = h.node.rid
+        self.strong_gen += 1
+        new = f"g{self.strong_gen}"
+        if not h.node.add_command({key: new}):
+            return
+        seq = h.node.version_vector()[rid]
+        self._journal_at(rid, seq, "strong", key, new)
+        self.strong_pending.setdefault(key, set()).add(new)
+        holder.crash()
+        self.report.crashes += 1
+        self.report.coordinator_crashes += 1
+
+    def _zombie_handoff(self) -> None:
+        """The zombie-coordinator scenario: every OTHER node's fake clock
+        jumps past the holder's lease (a paused/partitioned process whose
+        own clock stayed behind), a successor acquires fence+1 by quorum,
+        and the zombie's next CAS — stamped with its stale fence — must
+        be refused fleet-wide (cas_fenced_reject) and surface as an
+        indeterminate 503, never a second commit under the old epoch."""
+        alive = self._alive()
+        if len(alive) < 3:
+            return
+        key = self.rng.choice(self.STRONG_KEYS)
+        from crdt_tpu.consistency.leases import slot_of_key
+
+        lslot = slot_of_key(key, self.config.lease_slots)
+        # the zombie must be a holder that would DECIDE locally (its own
+        # routing view names itself) — a stale holder whose view forwards
+        # would just relay to the real coordinator, testing nothing
+        zombies = [
+            s for s in alive
+            if s.host.leases.held_fence(lslot) is not None
+            and s.host.leases.coordinator_of(lslot)
+            == s.host.leases.own_url
+        ]
+        if not zombies:
+            self._strong_op(key=key, force_cas=True)
+            return
+        zombie = zombies[0]
+        # freshen the grant first: a zombie is a coordinator whose lease
+        # was FRESH when the world moved on.  Within the half-life window
+        # its next ensure() answers from the local table without a wire
+        # round — exactly the stale-stamp path the fence must catch.  (A
+        # stale-enough grant would instead renew over the wire, learn the
+        # raised fence, and legitimately re-acquire — self-healing, but
+        # not the scenario.)
+        zombie.host.leases.ensure(lslot)
+        old_fence = zombie.host.leases.held_fence(lslot)
+        if old_fence is None:
+            return
+        for s in alive:
+            if s is not zombie:
+                s.plane_time.t += self.config.lease_duration_s + 1.0
+        succ = self.rng.choice([s for s in alive if s is not zombie])
+        # direct acquisition on the successor emulates the breaker-aged
+        # routing handoff (the rendezvous view stops naming a dead edge);
+        # faults may refuse the grant quorum — then no handoff happened
+        # and the zombie's push legitimately still commits under its own
+        # unexpired-by-quorum fence
+        fence = succ.host.leases.ensure(lslot)
+        handoff = fence is not None and fence > (old_fence or 0)
+        before = self.report.strong_indeterminate
+        before_rej = self._fenced_rejects_total()
+        self._strong_op(slot=zombie, key=key, force_cas=True)
+        # a zombie ATTEMPT is only the full story: handoff granted, the
+        # stale-stamped push actually refused somewhere (metric inc'd on
+        # the refusing replicas), and the zombie got its loud 503 — a
+        # transport drop that starved the push legs is a different fault
+        if (handoff and self.report.strong_indeterminate > before
+                and self._fenced_rejects_total() > before_rej):
+            self.report.zombie_attempts += 1
+
+    def _fenced_rejects_total(self) -> int:
+        """Fleet-wide ``cas_fenced_rejects`` counter fold (each refusing
+        replica incs its own registry)."""
+        return sum(
+            int(v) for s in self._alive()
+            for k, v in s.host.node.metrics.registry.snapshot().items()
+            if k.startswith("cas_fenced_rejects"))
 
     def step(self, step: int) -> None:
         self.plane.step = step
@@ -886,6 +1067,16 @@ class NemesisSoak:
                 ("write", "pull", "checkpoint", "crash", "reboot",
                  "barrier", "overload_burst"),
                 weights=(27, 33, 8, 4, 6, 2, 20),
+            )[0]
+        elif self.strong and self.crash_coordinator:
+            # plain crashes stay in the mix (they may hit non-holders);
+            # the two targeted scenarios take their slice from them and
+            # from writes, keeping pull/checkpoint pressure intact
+            action = self.rng.choices(
+                ("write", "pull", "checkpoint", "crash", "reboot",
+                 "barrier", "strong_op", "crash_leaseholder",
+                 "zombie_handoff"),
+                weights=(31, 33, 8, 2, 8, 2, 8, 5, 3),
             )[0]
         elif self.strong:
             action = self.rng.choices(
@@ -1053,8 +1244,9 @@ class NemesisSoak:
         self._audit_strong(key, val, op="recovery_read")
         self.strong_gen += 1
         new = f"g{self.strong_gen}"
-        cons.cas(key, val, new)
-        self._journal(slot.host.node.rid, "strong", key, new)
+        token = cons.cas(key, val, new)
+        (rid, seq), = token.items()
+        self._journal_at(rid, seq, "strong", key, new)
         self.strong_committed[key] = new
         self.strong_pending[key] = set()
         self.strong_view[key] = new
@@ -1093,6 +1285,48 @@ class NemesisSoak:
             "strong soak never completed a strong op: quorum settings or "
             "timeouts dead"
         )
+
+    def _check_fence_decisions(self) -> None:
+        """The fencing-token oracle: for every (lease slot, fence epoch),
+        at most ONE node ever announced a quorum-acked CAS decision.  A
+        ``cas_commit`` event is emitted by the deciding node into its OWN
+        black box, so the emitting log file IS the decider's identity —
+        two different log files sharing a (slot, fence) pair would mean a
+        zombie and its successor both committed under one epoch, exactly
+        what fencing exists to forbid.  (One decider repeating a pair is
+        legal: a lease covers many CAS ops.)  In crash-coordinator mode
+        the scenario must have fired: fenced commits observed, and every
+        audited zombie push left a ``cas_fenced_reject`` somewhere."""
+        deciders: Dict[Tuple[str, int], set] = {}
+        commits = rejects = 0
+        for s in self.slots:
+            for e in read_jsonl(s.event_log_path):
+                ev = e.get("event")
+                if ev == "cas_commit":
+                    commits += 1
+                    for slot_s, fence in (e.get("fences") or {}).items():
+                        deciders.setdefault(
+                            (slot_s, int(fence)), set()).add(s.slot)
+                elif ev == "cas_fenced_reject":
+                    rejects += 1
+        dup = {k: sorted(v) for k, v in deciders.items() if len(v) > 1}
+        assert not dup, (
+            f"split-brain decisions: multiple nodes committed under the "
+            f"same (lease slot, fence epoch): {dup} — fencing failed to "
+            "serialize coordinators"
+        )
+        self.report.cas_commits = commits
+        self.report.fenced_rejects = rejects
+        if self.crash_coordinator:
+            assert commits > 0, (
+                "crash-coordinator soak never quorum-committed a fenced "
+                "CAS: the lease plane was never exercised"
+            )
+            if self.report.zombie_attempts:
+                assert rejects > 0, (
+                    f"{self.report.zombie_attempts} zombie pushes audited "
+                    "but no cas_fenced_reject event in any black box"
+                )
 
     # ---- heal phase: recovery provenance + convergence + oracle ----
 
@@ -1541,6 +1775,12 @@ class NemesisSoak:
             # _BandClock was born at the plane's current step) into one
             # shared heal band above the whole run
             self._pin_clocks(self.steps)
+            # age every lease past its duration: whatever grants the run
+            # left behind (including a zombie's own stale view) expire,
+            # so the recovery CAS can re-acquire outright — a persisted
+            # fence floor plus the taught-fence retry does the rest
+            for s in self.slots:
+                s.plane_time.t += self.config.lease_duration_s + 1.0
         self._converge(max_rounds)
         if self.strong:
             self._check_strong_recovery()
@@ -1554,6 +1794,7 @@ class NemesisSoak:
         self._check_quarantine_provenance()
         if self.strong:
             self._check_strong_provenance()
+            self._check_fence_decisions()
         if self.overload:
             self._check_shed_provenance()
         # two-arm comparison inputs, captured on EVERY run: the --gc
@@ -1599,6 +1840,15 @@ class NemesisSoak:
         assert "crdt_union_path_total" in body, (
             "crdt_union_path_total missing from the served /metrics scrape"
         )
+        # the lease sampler rides the same scrape in EVERY mode: the
+        # per-slot state and fence-epoch gauges are scrape-fresh (set by
+        # a render callback), so a served host without them means the
+        # coordinator plane went unobservable
+        for gauge in ("crdt_lease_state", "crdt_lease_fence_epoch"):
+            assert gauge in body, (
+                f"{gauge} missing from the served /metrics scrape: lease "
+                "sampler not wired"
+            )
 
     def _check_assembly(self, min_coverage: float = 0.95) -> None:
         """The flight-recorder CI gate: assemble the fleet's JSONL logs
@@ -1671,12 +1921,15 @@ def run_soak(seed: int, nodes: int, steps: int,
              overload: bool = False,
              gc: bool = False,
              strong: bool = False,
+             crash_coordinator: bool = False,
              multitenant: bool = False) -> NemesisReport:
     rep = NemesisSoak(seed, nodes=nodes, steps=steps,
                       fault_log=fault_log, postmortem_dir=postmortem_dir,
                       assemble_check=assemble_check,
                       composite=composite, overload=overload,
-                      gc=gc, strong=strong, multitenant=multitenant).run()
+                      gc=gc, strong=strong,
+                      crash_coordinator=crash_coordinator,
+                      multitenant=multitenant).run()
     if gc:
         # shadow arm: the IDENTICAL soak with GC never driven.  The GC
         # drive sits outside the action rng and the fault coins are pure
@@ -1686,7 +1939,8 @@ def run_soak(seed: int, nodes: int, steps: int,
         shadow = NemesisSoak(seed, nodes=nodes, steps=steps,
                              postmortem_dir=postmortem_dir,
                              composite=composite, overload=overload,
-                             gc=False, strong=strong).run()
+                             gc=False, strong=strong,
+                             crash_coordinator=crash_coordinator).run()
         assert rep.writes_ledger == shadow.writes_ledger, (
             f"seed {seed}: GC arm minted {rep.writes_ledger} but the "
             f"shadow minted {shadow.writes_ledger} — the GC drive leaked "
@@ -1752,6 +2006,12 @@ def main(argv=None) -> int:
                          "strong ops must 503 (never serve stale) during "
                          "quorum loss, match consistency_unavailable "
                          "events 1:1, and recover outright after heal")
+    ap.add_argument("--crash-coordinator", action="store_true",
+                    help="(implies --strong) crash the acting leaseholder "
+                         "mid-CAS (post-mint, pre-push-quorum) and stage "
+                         "zombie handoffs: <=1 committed decision per "
+                         "(lease slot, fence epoch), every stale-stamped "
+                         "push refused loudly, full recovery after heal")
     ap.add_argument("--multitenant", action="store_true",
                     help="drive a simulated million-key, multi-tenant "
                          "workload through the sharded keyspace tier: "
@@ -1782,13 +2042,17 @@ def main(argv=None) -> int:
                                assemble_check=args.assemble_check,
                                composite=args.composite,
                                overload=args.overload,
-                               gc=args.gc, strong=args.strong,
+                               gc=args.gc,
+                               strong=args.strong or args.crash_coordinator,
+                               crash_coordinator=args.crash_coordinator,
                                multitenant=args.multitenant)
                 run_soak(seed, args.nodes, args.steps, fault_log=log_b,
                          postmortem_dir=args.postmortem_dir,
                          composite=args.composite,
                          overload=args.overload,
-                         gc=args.gc, strong=args.strong,
+                         gc=args.gc,
+                         strong=args.strong or args.crash_coordinator,
+                         crash_coordinator=args.crash_coordinator,
                          multitenant=args.multitenant)
                 a = pathlib.Path(log_a).read_bytes()
                 b = pathlib.Path(log_b).read_bytes()
@@ -1804,7 +2068,9 @@ def main(argv=None) -> int:
                            assemble_check=args.assemble_check,
                            composite=args.composite,
                            overload=args.overload,
-                           gc=args.gc, strong=args.strong,
+                           gc=args.gc,
+                           strong=args.strong or args.crash_coordinator,
+                           crash_coordinator=args.crash_coordinator,
                            multitenant=args.multitenant)
             print(f"[nemesis] {rep.summary()}")
         if args.race_check:

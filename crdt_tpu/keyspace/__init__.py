@@ -7,8 +7,8 @@
 from crdt_tpu.keyspace.frontdoor import (KeyspaceFrontDoor, TENANT_HEADER,
                                          TENANT_LANE,
                                          keyspace_front_door_from_config)
-from crdt_tpu.keyspace.routing import (RendezvousRouter, route_key,
-                                       validate_tenant)
+from crdt_tpu.keyspace.routing import (RendezvousRouter, ranked_members,
+                                       route_key, validate_tenant)
 from crdt_tpu.keyspace.shards import (ShardedKeyspace, keyspace_from_config,
                                       qualify, split_qualified)
 
@@ -21,6 +21,7 @@ __all__ = [
     "keyspace_from_config",
     "keyspace_front_door_from_config",
     "qualify",
+    "ranked_members",
     "route_key",
     "split_qualified",
     "validate_tenant",

@@ -61,6 +61,33 @@ def _score(member: str, key: str) -> int:
     return int.from_bytes(h.digest(), "big")
 
 
+def ranked_members(members: Sequence[str], key: str,
+                   n: int = None, ident=None) -> List[str]:
+    """Members by descending HRW weight for ``key`` — THE shared
+    rendezvous seam.  ``ranked_members(ms, k)[0]`` is the owner;
+    the full ranking is a deterministic failover order.
+
+    This is the module-level twin of ``RendezvousRouter.ranked`` for
+    callers whose member list changes per call (the consistency plane's
+    coordinator-lease routing ranks LIVE NODE URLS, which shift with
+    partitions, while the keyspace ranks a fixed ``shard-<i>`` list).
+    Both paths share ``_score``, so cross-use determinism is one
+    property: same members + same key → same ranking, whether the
+    members are shard names or node URLs (pinned by
+    tests/test_keyspace.py).  Ties break on the member string.
+
+    ``ident`` optionally maps a member to the STABLE identity string its
+    weight is computed over, while the returned list keeps the member
+    values themselves — for member strings that embed ephemeral detail
+    (a URL with an OS-assigned port) the caller can rank over stable
+    names so the routing replays across restarts."""
+    name = (lambda m: m) if ident is None else ident
+    order = sorted((str(m) for m in members),
+                   key=lambda m: (_score(str(name(m)), key), m),
+                   reverse=True)
+    return order if n is None else order[:n]
+
+
 class RendezvousRouter:
     """HRW router over a fixed member list.
 
@@ -91,11 +118,10 @@ class RendezvousRouter:
 
     def ranked(self, key: str, n: int = None) -> List[str]:
         """Members by descending weight for ``key`` (top ``n`` or all).
-        ``ranked(key)[0] == owner(key)``; the lease item uses the full
-        ranking as a deterministic failover order."""
-        order = sorted(self.members,
-                       key=lambda m: (_score(m, key), m), reverse=True)
-        return order if n is None else order[:n]
+        ``ranked(key)[0] == owner(key)``; delegates to the module-level
+        :func:`ranked_members` seam so the keyspace and the consistency
+        plane's lease routing can never fork."""
+        return ranked_members(self.members, key, n)
 
     # ---- membership-change constructors (minimal remap by design) ----
 

@@ -218,6 +218,23 @@ def sample_stability(registry, node_label: str, tracker) -> None:
                        len(tracker.stale_members()), node=node_label)
 
 
+def sample_leases(registry, node_label: str, leases) -> None:
+    """Coordinator-lease gauges (crdt_tpu.consistency.leases),
+    scrape-fresh: per-slot ``lease_state`` (0 follower / 1 held /
+    2 expired-unhandedoff — the zombie-risk window worth alerting on)
+    and ``lease_fence_epoch`` (highest fence this node knows for the
+    slot; a fleet-wide max that stops advancing while CAS traffic flows
+    means leases stopped handing off).  The companion counters —
+    ``crdt_cas_forwarded_total``, ``crdt_lease_grants_total``,
+    ``crdt_cas_fenced_rejects_total`` — are inc'd at the plane/manager
+    and render from the registry without sampling here."""
+    for slot, st in sorted(leases.slot_states().items()):
+        registry.set_gauge("lease_state", float(st["state"]),
+                           slot=str(slot), node=node_label)
+        registry.set_gauge("lease_fence_epoch", float(st["fence"]),
+                           slot=str(slot), node=node_label)
+
+
 def sample_race_watch(registry) -> None:
     """Witnessed-race detector gauges (analysis.verify.race): the current
     witness count plus per-watchpoint read/write traffic, so a soak run
@@ -264,7 +281,7 @@ def sample_union_paths(registry) -> None:
 def sample_all(registry, node, set_node=None, seq_node=None,
                map_node=None, composite_node=None, agent=None,
                ingest=None, stability=None, keyspace=None,
-               ks_door=None) -> None:
+               ks_door=None, leases=None) -> None:
     sample_kv_node(registry, node)
     sample_union_paths(registry)
     if set_node is not None:
@@ -283,17 +300,19 @@ def sample_all(registry, node, set_node=None, seq_node=None,
         sample_stability(registry, str(node.rid), stability)
     if keyspace is not None:
         sample_keyspace(registry, str(node.rid), keyspace, ks_door=ks_door)
+    if leases is not None:
+        sample_leases(registry, str(node.rid), leases)
 
 
 def render_node_metrics(node, set_node=None, seq_node=None,
                         map_node=None, composite_node=None,
                         agent=None, ingest=None, stability=None,
-                        keyspace=None, ks_door=None) -> str:
+                        keyspace=None, ks_door=None, leases=None) -> str:
     """The GET /metrics body: sample health gauges into the node's
     registry, then render the whole registry as Prometheus text."""
     registry = node.metrics.registry
     sample_all(registry, node, set_node=set_node, seq_node=seq_node,
                map_node=map_node, composite_node=composite_node,
                agent=agent, ingest=ingest, stability=stability,
-               keyspace=keyspace, ks_door=ks_door)
+               keyspace=keyspace, ks_door=ks_door, leases=leases)
     return registry.render_prometheus()

@@ -61,7 +61,8 @@ def _interner_load(strings: list, interner) -> None:
 
 
 def save_node(path: str, node, set_node=None, seq_node=None,
-              map_node=None, composite_node=None) -> None:
+              map_node=None, composite_node=None, keyspace=None,
+              leases=None) -> None:
     """Snapshot a ReplicaNode: op-tensor columns + interner tables + the
     raw command map (the gossip-serving source of truth).  ``set_node``
     (a crdt_tpu.api.setnode.SetNode) adds the daemon's set-lattice section
@@ -72,7 +73,13 @@ def save_node(path: str, node, set_node=None, seq_node=None,
     records + reset epochs); ``composite_node`` (crdt_tpu.api
     .compositenode.CompositeNode) adds the algebra composite's state dump
     — its snapshot IS its wire payload, so restore revalidates it like a
-    gossip body."""
+    gossip body.  ``keyspace`` (a crdt_tpu.keyspace.ShardedKeyspace) adds
+    one ``ks-shard-<i>.json`` per shard, each a full wire payload restored
+    through ``receive`` (the same validate-like-gossip posture as the
+    composite); ``leases`` (a crdt_tpu.consistency.leases.LeaseManager)
+    adds ``leases.json`` — the per-slot fence floors, persisted fail-stop
+    like quorum-acked writes so a rebooted replica keeps refusing the
+    stale fences it refused before."""
     p = pathlib.Path(path)
     p.mkdir(parents=True, exist_ok=True)
     if set_node is not None:
@@ -84,6 +91,29 @@ def save_node(path: str, node, set_node=None, seq_node=None,
     if composite_node is not None:
         (p / "composite.json").write_text(
             json.dumps(composite_node.to_snapshot()))
+    if keyspace is not None:
+        for i, shard in enumerate(keyspace.shards):
+            # full wire dump via the liveness-gated payload path: the
+            # alive flag is fault-injection state, not durable data (the
+            # restore side re-asserts the same rule), so a soft-dead
+            # shard still snapshots its ops rather than writing a hole
+            was_alive = shard.alive
+            shard.alive = True
+            try:
+                payload = shard.gossip_payload(since=None)
+            finally:
+                shard.alive = was_alive
+            (p / f"ks-shard-{i}.json").write_text(json.dumps({
+                "rid": shard.rid,
+                "seq": shard._seq.count,
+                "epoch_ms": shard.clock.epoch_ms,
+                "payload": payload or {},
+            }))
+    if leases is not None:
+        (p / "leases.json").write_text(json.dumps({
+            "fences": {str(s): f
+                       for s, f in leases.fences_snapshot().items()},
+        }))
     cols = {
         name: np.asarray(getattr(node.log, name))
         for name in ("ts", "rid", "seq", "key", "val", "payload", "is_num")
@@ -108,7 +138,7 @@ def save_node(path: str, node, set_node=None, seq_node=None,
 
 def restore_node(path: str, node, allow_rid_change: bool = False,
                  set_node=None, seq_node=None, map_node=None,
-                 composite_node=None) -> None:
+                 composite_node=None, keyspace=None, leases=None) -> None:
     """Restore a snapshot into a freshly-constructed ReplicaNode.
 
     ``allow_rid_change=True`` is the boot-incarnation path (see module
@@ -161,6 +191,35 @@ def restore_node(path: str, node, allow_rid_change: bool = False,
         # whole generation and falls back, same as any torn section
         composite_node.from_snapshot(
             json.loads((p / "composite.json").read_text()))
+    if keyspace is not None:
+        for i, shard in enumerate(keyspace.shards):
+            f = p / f"ks-shard-{i}.json"
+            if not f.exists():
+                continue  # snapshot predates the tier / smaller shard map
+            snap = json.loads(f.read_text())
+            payload = snap.get("payload")
+            if not isinstance(payload, dict):
+                raise ValueError(
+                    f"ks-shard-{i}.json: payload must be a wire dict, "
+                    f"got {type(payload).__name__}")
+            # receive() validates like a gossip body — a corrupt shard
+            # section raises here and load_latest_node quarantines the
+            # whole generation, exactly the composite's posture
+            shard.receive(payload)
+            if int(snap.get("rid", -1)) == shard.rid:
+                # same incarnation: the seq counter is still ours.  A
+                # fresh-rid boot keeps its zero-based counter (the old
+                # rid's ops are a frozen foreign-writer prefix)
+                shard._seq.count = int(snap.get("seq", 0))
+            shard.clock.epoch_ms = int(
+                snap.get("epoch_ms", shard.clock.epoch_ms))
+    if leases is not None and (p / "leases.json").exists():
+        snap = json.loads((p / "leases.json").read_text())
+        fences = snap.get("fences")
+        if not isinstance(fences, dict):
+            raise ValueError("leases.json: fences must be a "
+                             "{slot: fence} dict")
+        leases.restore_fences({int(s): int(f) for s, f in fences.items()})
 
 
 # ---- crash-safe versioned snapshots + boot incarnations ---------------------
@@ -244,7 +303,8 @@ def _quarantine_snap(rootp: pathlib.Path, snap: pathlib.Path) -> None:
 
 
 def save_node_atomic(root: str, node, set_node=None, seq_node=None,
-                     map_node=None, composite_node=None) -> str:
+                     map_node=None, composite_node=None, keyspace=None,
+                     leases=None) -> str:
     """Snapshot ``node`` into a fresh versioned directory under ``root``
     and atomically repoint LATEST at it — a SIGKILL at ANY instant leaves
     either the previous complete snapshot or the new complete snapshot as
@@ -267,7 +327,8 @@ def save_node_atomic(root: str, node, set_node=None, seq_node=None,
     shutil.rmtree(staging, ignore_errors=True)  # orphan from a past crash
     with node._lock:
         save_node(str(staging), node, set_node=set_node, seq_node=seq_node,
-                  map_node=map_node, composite_node=composite_node)
+                  map_node=map_node, composite_node=composite_node,
+                  keyspace=keyspace, leases=leases)
     # integrity manifest INSIDE the staging dir: the rename publishes the
     # snapshot and its checksums as one unit (a snapshot without a complete
     # manifest can only be a legacy one)
@@ -286,7 +347,8 @@ def save_node_atomic(root: str, node, set_node=None, seq_node=None,
 
 def load_latest_node(root: str, node, allow_rid_change: bool = True,
                      set_node=None, seq_node=None, map_node=None,
-                     composite_node=None) -> bool:
+                     composite_node=None, keyspace=None,
+                     leases=None) -> bool:
     """Restore the newest intact snapshot under ``root`` into ``node``;
     False when none restores (fresh boot).
 
@@ -323,7 +385,8 @@ def load_latest_node(root: str, node, allow_rid_change: bool = True,
                              allow_rid_change=allow_rid_change,
                              set_node=set_node, seq_node=seq_node,
                              map_node=map_node,
-                             composite_node=composite_node)
+                             composite_node=composite_node,
+                             keyspace=keyspace, leases=leases)
             except Exception as e:  # noqa: BLE001 — quarantined loudly below
                 err = f"restore failed: {type(e).__name__}: {e}"
         if err is not None:
@@ -337,6 +400,7 @@ def load_latest_node(root: str, node, allow_rid_change: bool = True,
             "snapshot_restore", snap=snap.name,
             fallback=snap.name != latest_name,
             verified=(snap / MANIFEST_NAME).is_file(),
+            ks_shards=len(list(snap.glob("ks-shard-*.json"))),
         )
         return True
     return False

@@ -143,6 +143,25 @@ class ClusterConfig:
     # deadline for a session read's dominance wait, and its poll cadence
     session_wait_s: float = 5.0
     session_poll_s: float = 0.02
+    # ---- coordinator leases (crdt_tpu.consistency.leases) ----
+    # routing slots for key -> coordinator rendezvous routing; each slot
+    # carries its own quorum-granted lease + fence epoch.  More slots
+    # spread coordination load; fewer amortize lease renewals.
+    lease_slots: int = 8
+    # lease validity window on the plane's injectable clock; holders
+    # renew at half-life, voters refuse a second holder until expiry
+    lease_duration_s: float = 5.0
+    # max forward hops for a CAS landing on a non-coordinator before it
+    # 503s loudly (forward_hops_exhausted) — bounds routing-view
+    # disagreement loops during partitions
+    cas_forward_hops: int = 2
+    # default staleness budget Δ for level="bounded" reads: the summed
+    # per-writer op lag the local vv may trail the quorum max by and
+    # still serve locally
+    bounded_staleness_ops: int = 64
+    # advisory Retry-After (seconds) served with consistency 503s, like
+    # ingest_retry_after_s is for the 429 shed path
+    consistency_retry_after_s: float = 0.05
 
     def __post_init__(self) -> None:
         # keyspace knobs fail the BOOT with a named fix, not the first
@@ -171,6 +190,31 @@ class ClusterConfig:
                         f"keyspace_tenant_quota[{t!r}]={q!r} must be a "
                         "positive int (max pending ops for the tenant's "
                         "quota slice)")
+        # lease knobs fail the boot with a named fix too — a zero-slot
+        # or zero-duration lease plane is a misconfiguration, never a
+        # degraded mode
+        if int(self.lease_slots) < 1:
+            raise ValueError(
+                f"lease_slots={self.lease_slots} must be a positive "
+                "routing-slot count (every key needs a coordinator slot)")
+        if float(self.lease_duration_s) <= 0:
+            raise ValueError(
+                f"lease_duration_s={self.lease_duration_s} must be a "
+                "positive lease validity window")
+        if int(self.cas_forward_hops) < 1:
+            raise ValueError(
+                f"cas_forward_hops={self.cas_forward_hops} must allow at "
+                "least one forward hop (non-coordinators must be able to "
+                "reach the leaseholder)")
+        if int(self.bounded_staleness_ops) < 0:
+            raise ValueError(
+                f"bounded_staleness_ops={self.bounded_staleness_ops} is "
+                "negative; use 0 for exact-quorum freshness or a positive "
+                "op budget")
+        if float(self.consistency_retry_after_s) < 0:
+            raise ValueError(
+                f"consistency_retry_after_s={self.consistency_retry_after_s}"
+                " must be a non-negative advisory backoff")
 
     def ports(self) -> List[int]:
         return [self.base_port + i for i in range(self.n_replicas)]

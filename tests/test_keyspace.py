@@ -25,7 +25,7 @@ from crdt_tpu.ingest.shed import ShedPolicy
 from crdt_tpu.keyspace import (KeyspaceFrontDoor, ShardedKeyspace,
                                TENANT_LANE, qualify, route_key,
                                split_qualified, validate_tenant)
-from crdt_tpu.keyspace.routing import RendezvousRouter
+from crdt_tpu.keyspace.routing import RendezvousRouter, ranked_members
 from crdt_tpu.obs.events import EventLog
 from crdt_tpu.utils.config import ClusterConfig
 
@@ -129,6 +129,38 @@ def test_rendezvous_ranked_and_member_hygiene():
         RendezvousRouter(["a", "a"])
     with pytest.raises(ValueError):
         router.without_member("nope")
+
+
+def test_ranked_members_is_the_shared_rendezvous_seam():
+    """Cross-use determinism: the module-level ``ranked_members`` (what
+    the consistency plane's coordinator-lease routing ranks LIVE NODE
+    URLS with) and ``RendezvousRouter.ranked`` (what the keyspace ranks
+    shard names with) are ONE function — same members + same key ->
+    same ranking, whatever the member strings look like."""
+    member_sets = (
+        [f"shard-{i}" for i in range(6)],
+        [f"http://127.0.0.1:{8000 + i}" for i in range(5)],
+    )
+    for members in member_sets:
+        router = RendezvousRouter(members)
+        for k in _keys(48) + [f"lease-slot-{s}" for s in range(8)]:
+            assert router.ranked(k) == ranked_members(members, k)
+            assert router.owner(k) == ranked_members(members, k, 1)[0]
+    # ident-based ranking: weight over the STABLE name, returned values
+    # stay the member strings — two fleets whose ephemeral URLs map to
+    # the same member names route identically (what lets the nemesis
+    # soak replay byte-identically across OS-assigned ports)
+    urls_a = [f"http://127.0.0.1:{7000 + i}" for i in range(4)]
+    urls_b = [f"http://127.0.0.1:{9100 + i}" for i in range(4)]
+    ident_a = {u: f"member-{i}" for i, u in enumerate(urls_a)}
+    ident_b = {u: f"member-{i}" for i, u in enumerate(urls_b)}
+    for k in [f"lease-slot-{s}" for s in range(8)]:
+        ra = ranked_members(urls_a, k, ident=ident_a.get)
+        rb = ranked_members(urls_b, k, ident=ident_b.get)
+        assert [ident_a[m] for m in ra] == [ident_b[m] for m in rb]
+        # and ident=None stays byte-compatible with the router
+        assert ranked_members(urls_a, k, ident=None) == \
+            RendezvousRouter(urls_a).ranked(k)
 
 
 # ---- qualified keys & shard routing ----

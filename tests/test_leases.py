@@ -1,0 +1,340 @@
+"""Coordinator leases + fencing tokens (consistency/leases.py): the
+deterministic fake-clock half of what ``nemesis_soak --strong
+--crash-coordinator`` hammers end-to-end.
+
+Every manager runs on a manual clock that only moves when a test moves
+it, and every "wire" grant lands directly on the target manager's voter
+side — so the double-holder, expiry, handoff, and clock-skew scenarios
+here are exact, not raced.
+"""
+from __future__ import annotations
+
+import pytest
+
+from crdt_tpu.consistency.leases import (
+    LEASE_STATE,
+    LeaseManager,
+    slot_of_key,
+)
+from crdt_tpu.keyspace.routing import RendezvousRouter, ranked_members
+from crdt_tpu.obs.events import EventLog
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+
+class CountMetrics:
+    def __init__(self):
+        self.counts = {}
+
+    def inc(self, name, *a, **kw):
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+
+class LeasePeer:
+    """RemotePeer stand-in: ``lease_grant`` lands on the target
+    manager's voter side exactly like POST /lease/grant, with switches
+    for a dead transport and an open breaker."""
+
+    def __init__(self, mgr: LeaseManager, url: str):
+        self.mgr = mgr
+        self.url = url
+        self.backed = False
+        self.down = False
+        self.grant_calls = 0
+
+    def backed_off(self) -> bool:
+        return self.backed
+
+    def backoff_peek(self) -> bool:
+        return self.backed
+
+    def lease_grant(self, *, slot, holder, fence, ttl):
+        self.grant_calls += 1
+        if self.down:
+            return None
+        return self.mgr.grant(slot, holder, fence, ttl)
+
+
+def mk_cluster(n: int, *, duration: float = 10.0, shared_clock=True):
+    """n managers fully meshed over LeasePeers.  Returns (managers,
+    clocks, peer-matrix); with ``shared_clock`` every node reads ONE
+    clock, else each node gets its own (the skew tests)."""
+    clocks = [ManualClock() for _ in range(n)]
+    if shared_clock:
+        clocks = [clocks[0]] * n
+    mgrs = [
+        LeaseManager(None, n_slots=4, duration=duration,
+                     clock=clocks[i].now, events=EventLog(node=f"n{i}"),
+                     metrics=CountMetrics())
+        for i in range(n)
+    ]
+    peers = {
+        i: [LeasePeer(mgrs[j], f"http://n{j}") for j in range(n) if j != i]
+        for i in range(n)
+    }
+    for i, m in enumerate(mgrs):
+        m.attach(f"http://n{i}", (lambda i=i: peers[i]))
+    return mgrs, clocks, peers
+
+
+def holders(mgrs, slot):
+    return [i for i, m in enumerate(mgrs) if m.held_fence(slot) is not None]
+
+
+# ------------------------------------------------------------ routing
+
+
+def test_slot_of_key_deterministic_and_in_range():
+    for key in ("reg-a", "reg-b", "user:42", ""):
+        s = slot_of_key(key, 8)
+        assert 0 <= s < 8
+        assert s == slot_of_key(key, 8)  # no per-process salt
+    # a realistic key pool should not collapse onto one slot
+    assert len({slot_of_key(f"k{i}", 8) for i in range(64)}) > 1
+
+
+def test_coordinator_view_is_shared_across_members():
+    mgrs, _, _ = mk_cluster(3)
+    for slot in range(4):
+        views = {m.coordinator_of(slot) for m in mgrs}
+        assert len(views) == 1, (
+            f"slot {slot}: members disagree on the coordinator with "
+            f"identical live views: {views}"
+        )
+
+
+def test_rendezvous_seam_matches_router_for_urls():
+    """Cross-use determinism (ISSUE satellite): the lease plane's
+    ranked_members and the keyspace's RendezvousRouter are ONE seam —
+    same members + same key -> same ranking, whether the members are
+    shard names or node URLs."""
+    for members in (
+        [f"shard-{i}" for i in range(5)],
+        [f"http://10.0.0.{i}:8430" for i in range(1, 6)],
+        ["http://n0", "http://n1", "http://n2"],
+    ):
+        router = RendezvousRouter(members)
+        for key in ("reg-a", "lease-slot-3", "tenant\x00k1", "x"):
+            assert router.ranked(key) == ranked_members(members, key)
+            assert router.owner(key) == ranked_members(members, key)[0]
+
+
+def test_ranked_members_ident_ranks_over_stable_names():
+    """With ``ident``, the weight is computed over the stable name while
+    the returned values stay the member strings — two member lists that
+    map to the same idents rank identically (port-blind routing)."""
+    ident_a = {"http://h:1111": "member-0", "http://h:2222": "member-1"}
+    ident_b = {"http://h:9999": "member-0", "http://h:8888": "member-1"}
+    for key in ("lease-slot-0", "lease-slot-1", "reg-c"):
+        ra = ranked_members(sorted(ident_a), key, ident=ident_a.get)
+        rb = ranked_members(sorted(ident_b), key, ident=ident_b.get)
+        assert [ident_a[m] for m in ra] == [ident_b[m] for m in rb]
+
+
+# ------------------------------------------------------- no double holder
+
+
+def test_single_holder_while_lease_unexpired():
+    mgrs, _, _ = mk_cluster(3)
+    slot = 0
+    fence = mgrs[0].ensure(slot)
+    assert fence == 1
+    assert holders(mgrs, slot) == [0]
+    # every other member is refused while the grant is unexpired: their
+    # acquisition must NOT spin past the live holder
+    assert mgrs[1].ensure(slot) is None
+    assert mgrs[2].ensure(slot) is None
+    assert holders(mgrs, slot) == [0]
+    # the holder's own ensure is a no-wire fast path inside half-life
+    assert mgrs[0].ensure(slot) == 1
+
+
+def test_reacquire_before_expiry_keeps_same_fence():
+    mgrs, clocks, _ = mk_cluster(3, duration=10.0)
+    slot = 1
+    assert mgrs[0].ensure(slot) == 1
+    clocks[0].t = 6.0  # past half-life: ensure renews through the quorum
+    assert mgrs[0].ensure(slot) == 1
+    # renewal re-extended expiry: still held well past the original ttl
+    clocks[0].t = 12.0
+    assert mgrs[0].held_fence(slot) == 1
+
+
+# ------------------------------------------------------ expiry + renewal
+
+
+def test_expiry_mid_renewal_keeps_lease_until_ttl_then_drops():
+    """A coordinator cut off from the quorum keeps its lease only until
+    ttl: failed renewals never self-extend, and after expiry the
+    acquisition path needs a quorum it cannot reach."""
+    mgrs, clocks, peers = mk_cluster(3, duration=10.0)
+    slot = 2
+    assert mgrs[0].ensure(slot) == 1
+    for p in peers[0]:
+        p.down = True  # transport dead: renewal votes go unanswered
+    clocks[0].t = 6.0  # past half-life -> renewal round fails
+    assert mgrs[0].ensure(slot) == 1, (
+        "failed renewal must keep the still-unexpired lease"
+    )
+    assert mgrs[0].metrics.counts.get("lease_renew_failures", 0) >= 1
+    clocks[0].t = 10.0  # ttl reached: the lease lapses, loudly
+    assert mgrs[0].held_fence(slot) is None
+    assert mgrs[0].events.find(event="lease_expire")
+    assert mgrs[0].ensure(slot) is None, (
+        "an isolated coordinator must not re-acquire without a quorum"
+    )
+
+
+def test_handoff_after_expiry_bumps_fence():
+    mgrs, clocks, _ = mk_cluster(3, duration=10.0)
+    slot = 0
+    assert mgrs[0].ensure(slot) == 1
+    clocks[0].t = 11.0  # everyone agrees the grant lapsed
+    f2 = mgrs[1].ensure(slot)
+    assert f2 == 2, "the successor must open a NEW fence epoch"
+    assert holders(mgrs, slot) == [1]
+    # the old holder's stamp is now refused wherever the new fence is
+    # known — the zombie firewall the push path leans on
+    verdict = mgrs[1].check_push_fences({slot: 1})
+    assert verdict == {"slot": slot, "fence": 2}
+    assert mgrs[1].events.find(event="cas_fenced_reject")
+
+
+def test_fence_monotone_across_repeated_handoffs():
+    mgrs, clocks, _ = mk_cluster(3, duration=10.0)
+    slot = 3
+    fences = []
+    for round_i in range(6):
+        owner = round_i % 3
+        f = mgrs[owner].ensure(slot)
+        assert f is not None
+        fences.append(f)
+        clocks[0].t += 11.0  # lapse, next round's owner takes over
+    assert fences == sorted(fences)
+    assert len(set(fences)) == len(fences), (
+        f"fence epochs repeated across handoffs: {fences}"
+    )
+
+
+def test_restored_fences_keep_refusing_after_crash():
+    """Fail-stop persistence: a rebooted voter restored from its
+    checkpointed fence floor refuses stale stamps it refused before,
+    and proposers start above the floor."""
+    mgrs, clocks, _ = mk_cluster(3, duration=10.0)
+    slot = 0
+    for _ in range(3):
+        mgrs[0].ensure(slot)
+        clocks[0].t += 11.0
+        mgrs[1].ensure(slot)
+        clocks[0].t += 11.0
+    snap = mgrs[1].fences_snapshot()
+    reborn = LeaseManager(None, n_slots=4, duration=10.0,
+                          clock=clocks[0].now,
+                          events=EventLog(node="reborn"),
+                          metrics=CountMetrics())
+    reborn.restore_fences(snap)
+    floor = snap[slot]
+    assert floor >= 2
+    assert reborn.fence_of(slot) == floor
+    assert reborn.check_push_fences({slot: floor - 1}) is not None
+    refused = reborn.grant(slot, "http://zombie", floor - 1, 10.0)
+    assert not refused["granted"] and refused["fence"] == floor
+
+
+# ------------------------------------------------------------ clock skew
+
+
+def test_skewed_zombie_view_is_fenced_not_trusted():
+    """Clock skew makes lease VIEWS diverge: the zombie's slow clock
+    says 'held' long after the fleet moved on.  Routing views never
+    arbitrate — the fence does: the successor holds a higher epoch, the
+    zombie's stamp is refused, and learning the new fence self-heals
+    the zombie's table."""
+    mgrs, clocks, _ = mk_cluster(3, duration=10.0, shared_clock=False)
+    slot = 0
+    assert mgrs[0].ensure(slot) == 1
+    # the fleet's clocks advance past the ttl; the zombie's stands still
+    clocks[1].t = clocks[2].t = 12.0
+    f2 = mgrs[1].ensure(slot)
+    assert f2 == 2
+    # BOTH tables now claim 'held' — exactly the double-view skew makes
+    assert mgrs[0].held_fence(slot) == 1
+    assert mgrs[1].held_fence(slot) == 2
+    # ...but the zombie's stamp cannot pass any fence-aware replica
+    assert mgrs[1].check_push_fences({slot: 1}) == {"slot": slot,
+                                                    "fence": 2}
+    assert mgrs[2].check_push_fences({slot: 1}) == {"slot": slot,
+                                                    "fence": 2}
+    # the refusal teaches the zombie the successor's fence: its stale
+    # hold is dropped on the spot, no expiry wait needed
+    mgrs[0].note_fence(slot, 2)
+    assert mgrs[0].held_fence(slot) is None
+
+
+def test_skewed_voter_refuses_equal_fence_other_holder():
+    """Voter rule under skew: a voter whose grant has EXPIRED by its own
+    clock still refuses an equal-fence proposal from a different holder
+    — epochs are single-writer even when expiry views disagree."""
+    mgrs, clocks, _ = mk_cluster(2, duration=10.0, shared_clock=False)
+    slot = 1
+    got = mgrs[1].grant(slot, "http://n0", 1, 10.0)
+    assert got["granted"]
+    # while the grant is live, the SAME holder renewing its epoch is fine
+    renew = mgrs[1].grant(slot, "http://n0", 1, 10.0)
+    assert renew["granted"]
+    clocks[1].t = 20.0  # voter's view: that grant is long gone
+    again = mgrs[1].grant(slot, "http://other", 1, 10.0)
+    assert not again["granted"], (
+        "fence 1 was burned by n0; a second holder at the same epoch "
+        "would let two coordinators stamp identical tokens"
+    )
+    assert again["fence"] == 1
+    # once expired, even the ORIGINAL holder cannot re-enter epoch 1:
+    # the voter can no longer prove no one else burned it meanwhile
+    stale = mgrs[1].grant(slot, "http://n0", 1, 10.0)
+    assert not stale["granted"]
+
+
+def test_taught_fence_retry_recovers_in_one_round():
+    """A coordinator behind on fence gossip proposes low, is refused
+    with the blocking fence named, and must recover by retrying ONCE
+    above the taught value — not by spinning, not by giving up."""
+    mgrs, _, peers = mk_cluster(3)
+    slot = 2
+    for m in mgrs[1:]:
+        m.note_fence(slot, 7)  # the fleet knows an epoch mgr0 missed
+    calls_before = [p.grant_calls for p in peers[0]]
+    fence = mgrs[0].ensure(slot)
+    assert fence == 8, f"expected one taught retry to land 8, got {fence}"
+    rounds = sum(p.grant_calls for p in peers[0]) - sum(calls_before)
+    assert rounds <= 4, (
+        f"taught-fence recovery burned {rounds} grant calls; the retry "
+        "must be bounded to one extra round"
+    )
+
+
+def test_slot_states_gauge_encoding():
+    mgrs, clocks, _ = mk_cluster(3, duration=10.0)
+    slot = 0
+    assert mgrs[0].ensure(slot) == 1
+    st = mgrs[0].slot_states()
+    assert st[slot] == {"state": LEASE_STATE["held"], "fence": 1}
+    assert all(v["state"] == LEASE_STATE["follower"]
+               for s, v in st.items() if s != slot)
+    clocks[0].t = 11.0
+    assert (mgrs[0].slot_states()[slot]["state"]
+            == LEASE_STATE["expired"])
+    # held_fence observes the lapse -> the slot returns to follower
+    assert mgrs[0].held_fence(slot) is None
+    assert (mgrs[0].slot_states()[slot]["state"]
+            == LEASE_STATE["follower"])
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
