@@ -77,31 +77,100 @@ def _run_block(n_rounds: int, registry) -> float:
         gc.enable()
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rounds", type=int, default=150,
-                    help="pull rounds per block")
-    ap.add_argument("--blocks", type=int, default=5,
-                    help="interleaved A/B blocks per config")
-    args = ap.parse_args()
+def _run_ks_block(n_rounds: int, registry) -> float:
+    """Seconds for n_rounds tenant-admit + shard-pull rounds against a
+    fresh keyspace pair plus a held lease.
 
+    The ISSUE-16 observability additions all ride this loop: the
+    per-tenant admit-latency observe in the drain, the quota-slice shed
+    bookkeeping, per-shard birth stamps with the {shard} label, the
+    tenant_of extraction + {tenant,shard}-labeled propagation
+    histograms on the receive side, and the per-round lease fast path
+    (held-fence check + push-fence validation).  Same A/B contract as
+    the host-plane block: the recorder rides ``registry.enabled``, so
+    the NullRegistry arm runs the identical loop with the whole
+    provenance path off.
+    """
+    from crdt_tpu.api.node import pull_round
+    from crdt_tpu.consistency.leases import LeaseManager
+    from crdt_tpu.keyspace.frontdoor import KeyspaceFrontDoor
+    from crdt_tpu.keyspace.shards import ShardedKeyspace
+    from crdt_tpu.obs.provenance import BirthLedger
+    from crdt_tpu.obs.trace import mint_trace_id
+    from crdt_tpu.utils.clock import HostClock
+    from crdt_tpu.utils.metrics import Metrics
+
+    clock = HostClock()
+    metrics = Metrics(registry=registry)
+    n_shards = 2
+    writer = ShardedKeyspace(rid=0, n_shards=n_shards, capacity=4096,
+                             metrics=metrics, clock=clock)
+    puller = ShardedKeyspace(rid=1, n_shards=n_shards, capacity=4096,
+                             metrics=metrics, clock=clock)
+    # per-shard fleet-shared ledgers: shard i of every member shares one
+    # (the soak's topology), so the puller's merges resolve births
+    step = {"n": 0}
+    ledgers = [BirthLedger() for _ in range(n_shards)]
+    for ks in (writer, puller):
+        for i, shard in enumerate(ks.shards):
+            shard.recorder.install(ledger=ledgers[i],
+                                   step_clock=lambda: step["n"])
+    # max_batch=1 drains inline on the admitting thread — every admit
+    # pays the full lane round-trip (book -> flush -> ticket resolve)
+    door = KeyspaceFrontDoor(writer, max_batch=1, flush_deadline_s=60.0,
+                             metrics=metrics, node="0")
+    leases = LeaseManager(writer.shards[0], n_slots=1, duration=3600.0,
+                          metrics=metrics)
+    leases.attach("http://self", lambda: [])
+    fence = leases.ensure(0)  # 0 peers: self-vote quorum of 1 grants
+    tenants = ("t-acme", "t-bolt")
+    # warm the jit caches outside the timed region
+    for t in tenants:
+        door.admit_kv(t, "warm", "1")
+    for i in range(n_shards):
+        pull_round(puller.shards[i], writer.shards[i].gossip_payload,
+                   metrics, delta=True, peer="0", trace=mint_trace_id(1))
+    import gc
+
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        for i in range(n_rounds):
+            step["n"] = i
+            for t in tenants:
+                door.admit_kv(t, f"k{i % 8}", str(i))
+            leases.ensure(0)
+            leases.check_push_fences({0: fence})
+            for s in range(n_shards):
+                pull_round(
+                    puller.shards[s], writer.shards[s].gossip_payload,
+                    metrics, delta=True, peer="0", trace=mint_trace_id(1),
+                )
+        return time.perf_counter() - t0
+    finally:
+        gc.enable()
+
+
+def _ab(block_fn, rounds: int, blocks: int, metric: str):
+    """Interleaved A/B over one block function; returns the JSON row."""
     from crdt_tpu.obs.registry import NULL_REGISTRY, MetricsRegistry
 
     real, null = [], []
-    for _ in range(args.blocks):
-        real.append(_run_block(args.rounds, MetricsRegistry()))
-        null.append(_run_block(args.rounds, NULL_REGISTRY))
-    t_real = min(real) / args.rounds
-    t_null = min(null) / args.rounds
+    for _ in range(blocks):
+        real.append(block_fn(rounds, MetricsRegistry()))
+        null.append(block_fn(rounds, NULL_REGISTRY))
+    t_real = min(real) / rounds
+    t_null = min(null) / rounds
     overhead_pct = 100.0 * (t_real - t_null) / t_null
-    line = {
-        "metric": "obs_overhead_pull_round",
+    return {
+        "metric": metric,
         "value": round(overhead_pct, 2),
         "unit": "%",
         "vs_baseline": None,
         "note": (
             f"metrics-enabled vs no-op registry over "
-            f"{args.blocks}x{args.rounds} interleaved pull rounds "
+            f"{blocks}x{rounds} interleaved rounds "
             f"({t_real * 1e6:.1f}us vs {t_null * 1e6:.1f}us/round); "
             f"acceptance <= 5%: "
             f"{'PASS' if overhead_pct <= 5.0 else 'FAIL'}"
@@ -109,8 +178,28 @@ def main() -> int:
         "us_per_round_real": round(t_real * 1e6, 2),
         "us_per_round_null": round(t_null * 1e6, 2),
     }
-    print(json.dumps(line), flush=True)
-    return 0 if overhead_pct <= 5.0 else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=150,
+                    help="pull rounds per block")
+    ap.add_argument("--blocks", type=int, default=5,
+                    help="interleaved A/B blocks per config")
+    ap.add_argument("--skip-ks", action="store_true",
+                    help="host-plane block only (the pre-keyspace shape)")
+    args = ap.parse_args()
+
+    rows = [_ab(_run_block, args.rounds, args.blocks,
+                "obs_overhead_pull_round")]
+    if not args.skip_ks:
+        # the keyspace round does ~2 shard pulls + 2 admits + the lease
+        # fast path per iteration — fewer rounds keep wall time level
+        rows.append(_ab(_run_ks_block, max(1, args.rounds // 2),
+                        args.blocks, "obs_overhead_ks_round"))
+    for line in rows:
+        print(json.dumps(line), flush=True)
+    return 0 if all(r["value"] <= 5.0 for r in rows) else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,156 @@
+"""Ratcheting perf gate: judge a bench run against benches/baseline.json.
+
+ROADMAP ("Cash the bitmap win") asks for committed BENCH_TABLE baselines
+with tolerance bands checked in CI, so a measured regression fails the
+PR instead of drifting silently.  This is that check:
+
+    python benches/check_baseline.py --check-bench-baseline rows.jsonl ...
+
+``rows.jsonl`` is the captured stdout of any bench in this directory —
+every bench already emits one JSON object per line.  Two line shapes are
+understood:
+
+* ``{"metric": <name>, "value": <number>, ...}`` — the bench_baseline /
+  bench_obs_overhead row shape; keys directly into the baseline table.
+* ``{"bench": <name>, <field>: <number>, ...}`` — summary-object shape
+  (bench_keyspace); matched through a baseline entry's ``field_of``.
+
+The gate judges ONLY metrics the run actually emitted: a CPU CI run is
+never failed over chip rows it could not measure, and a chip run is
+never failed over CPU-only rows.  Baseline entries carry either an
+absolute cap (``max``/``min`` — acceptance bars like the <=5%
+instrumentation-overhead bar) or a committed ``value`` with a
+``tolerance_pct`` band and a ``direction``; a measurement past the band
+in the BAD direction fails, and one past it in the GOOD direction is
+reported as a ratchet candidate (re-pin the baseline with the fresh
+committed number).  Non-JSON lines in the capture are ignored, so
+``bench | tee rows.jsonl`` works unmodified.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Any, Dict, List, Tuple
+
+DEFAULT_BASELINE = pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_samples(paths: List[str]) -> Tuple[Dict[str, float],
+                                            List[Dict[str, Any]]]:
+    """All (metric -> last value) rows plus every summary-shape object."""
+    metrics: Dict[str, float] = {}
+    summaries: List[Dict[str, Any]] = []
+    for path in paths:
+        for line in pathlib.Path(path).read_text().splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(obj, dict):
+                continue
+            if "metric" in obj and isinstance(obj.get("value"), (int, float)):
+                metrics[str(obj["metric"])] = float(obj["value"])
+            elif "bench" in obj:
+                summaries.append(obj)
+    return metrics, summaries
+
+
+def _measured(name: str, spec: Dict[str, Any], metrics: Dict[str, float],
+              summaries: List[Dict[str, Any]]):
+    """The run's value for one baseline row, or None when not emitted."""
+    if name in metrics:
+        return metrics[name]
+    field_of = spec.get("field_of")
+    if field_of:
+        for obj in summaries:
+            if obj.get("bench") == field_of.get("bench"):
+                v = obj.get(field_of.get("field"))
+                if isinstance(v, (int, float)):
+                    return float(v)
+    return None
+
+
+def judge(spec: Dict[str, Any], value: float) -> Tuple[str, str]:
+    """-> (verdict, detail); verdict in {"ok", "fail", "ratchet"}."""
+    if "max" in spec:
+        cap = float(spec["max"])
+        if value > cap:
+            return "fail", f"{value} > cap {cap}"
+        return "ok", f"{value} <= cap {cap}"
+    if "min" in spec:
+        floor = float(spec["min"])
+        if value < floor:
+            return "fail", f"{value} < floor {floor}"
+        return "ok", f"{value} >= floor {floor}"
+    base = float(spec["value"])
+    tol = float(spec.get("tolerance_pct", 10.0)) / 100.0
+    lo, hi = base * (1.0 - tol), base * (1.0 + tol)
+    higher_good = spec.get("direction", "higher_is_better") \
+        == "higher_is_better"
+    if higher_good:
+        if value < lo:
+            return "fail", f"{value} < band floor {lo:.6g} " \
+                f"(baseline {base}, -{spec.get('tolerance_pct', 10)}%)"
+        if value > hi:
+            return "ratchet", f"{value} beats baseline {base} by more " \
+                "than the band — re-pin with a committed run"
+    else:
+        if value > hi:
+            return "fail", f"{value} > band ceiling {hi:.6g} " \
+                f"(baseline {base}, +{spec.get('tolerance_pct', 10)}%)"
+        if value < lo:
+            return "ratchet", f"{value} beats baseline {base} by more " \
+                "than the band — re-pin with a committed run"
+    return "ok", f"{value} within ±{spec.get('tolerance_pct', 10)}% " \
+        f"of {base}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check-bench-baseline", nargs="+", metavar="ROWS",
+                    dest="rows", required=True,
+                    help="captured bench stdout (JSONL) to judge")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline table (default: benches/baseline.json)")
+    ap.add_argument("--require", nargs="*", default=[],
+                    help="metrics that MUST be present in the run "
+                         "(missing -> fail); default: judge only what ran")
+    args = ap.parse_args(argv)
+
+    table = json.loads(pathlib.Path(args.baseline).read_text())
+    metrics, summaries = load_samples(args.rows)
+    n_fail = n_ok = n_skip = 0
+    for name, spec in sorted(table["metrics"].items()):
+        value = _measured(name, spec, metrics, summaries)
+        if value is None:
+            n_skip += 1
+            if name in args.require:
+                n_fail += 1
+                print(f"FAIL {name}: required but not emitted by this run")
+            continue
+        verdict, detail = judge(spec, value)
+        if verdict == "fail":
+            n_fail += 1
+            print(f"FAIL {name} [{spec.get('backend', '?')}]: {detail}")
+        elif verdict == "ratchet":
+            n_ok += 1
+            print(f"RATCHET {name}: {detail}")
+        else:
+            n_ok += 1
+            print(f"ok   {name}: {detail}")
+    print(f"baseline check: {n_ok} ok, {n_fail} fail, "
+          f"{n_skip} not in this run")
+    if n_ok == 0 and n_fail == 0:
+        print("FAIL: run emitted none of the baselined metrics "
+              "(wrong capture file?)")
+        return 1
+    return 1 if n_fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,7 +153,7 @@ from crdt_tpu.consistency.stability import STABILITY_HEADER, encode_summary
 from crdt_tpu.ingest import PageFormatError, ShedError
 from crdt_tpu.keyspace import TENANT_HEADER
 from crdt_tpu.obs import health
-from crdt_tpu.obs.trace import TRACE_HEADER
+from crdt_tpu.obs.trace import TRACE_HEADER, span
 
 PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -446,17 +446,29 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     if since == "bad":
                         self._send(400, "invalid vv")
                         return
+                    trace = self.headers.get(TRACE_HEADER)
                     payload = ks.gossip_payload(shard, since=since)
+                    if trace:
+                        # serve side of a shard round: same trace id as
+                        # the puller's ks_pull_* events (the host plane's
+                        # gossip_serve pattern, gone shard-scoped)
+                        self.node.events.emit(
+                            "ks_gossip_serve", trace=trace, shard=shard,
+                            peer=self.client_address[0],
+                            delta=since is not None,
+                        )
                     # the shard's stability summary rides the BODY: a
                     # round pulls several shards and the header slot
                     # holds only one summary (net.RemotePeer)
                     vv, frontier = ks.vv_snapshot(shard)
-                    self._send(200, json.dumps({
+                    self._send_bytes(200, json.dumps({
                         "payload": payload,
                         "vv": {str(r): s for r, s in vv.items()},
                         "frontier": {str(r): s
                                      for r, s in frontier.items()},
-                    }), "application/json")
+                    }).encode(), "application/json",
+                        extra_headers={TRACE_HEADER: trace} if trace
+                        else None)
                 elif url.path == "/ks/data":
                     if not self.node.alive:
                         self._send(502, "Unreachable")
@@ -492,6 +504,47 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     leases=self.leases,
                 )
                 self._send(200, body, PROM_CTYPE)
+            elif url.path == "/fleet":
+                # fleet SLO rollup: this node's exposition + every
+                # reachable peer's /metrics, folded by obs.fleet (the
+                # same code path as `python -m crdt_tpu.obs fleet`).
+                # slo_breach events land in THIS node's black box.
+                from crdt_tpu.obs import fleet as fleet_lib
+
+                own = health.render_node_metrics(
+                    self.node, set_node=self.set_node,
+                    seq_node=self.seq_node, map_node=self.map_node,
+                    composite_node=self.composite_node,
+                    agent=getattr(admin, "agent", None),
+                    ingest=self.ingest,
+                    stability=getattr(getattr(admin, "agent", None),
+                                      "stability", None),
+                    keyspace=self.keyspace,
+                    ks_door=self.ks_door,
+                    leases=self.leases,
+                )
+                texts = {str(self.node.rid): own}
+                agent = getattr(admin, "agent", None)
+                if agent is not None:
+                    for p in agent.peers:
+                        if p.backed_off():
+                            continue
+                        text = p.metrics_text()
+                        if text is not None:
+                            texts[p.url] = text
+                q = parse_qs(url.query)
+                slo = {}
+                for key in ("admit_p99_ms", "prop_p99_steps",
+                            "shed_ratio"):
+                    if key in q:
+                        try:
+                            slo[key] = float(q[key][0])
+                        except ValueError:
+                            self._send(400, f"invalid {key}")
+                            return
+                report = fleet_lib.fleet_from_texts(
+                    texts, slo=slo or None, events=self.node.events)
+                self._send(200, json.dumps(report), "application/json")
             elif url.path == "/ping":
                 if self.node.ping():
                     self._send(200, "Pong")
@@ -1036,6 +1089,8 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     assert isinstance(payload, dict)
                     fences = {int(s): int(f)
                               for s, f in (body.get("fences") or {}).items()}
+                    trace = body.get("trace")
+                    trace = None if trace is None else str(trace)
                 except Exception:
                     self._send(400, "invalid payload")
                     return
@@ -1045,8 +1100,11 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 if fences and self.leases is not None:
                     # fence firewall BEFORE the merge: a push stamped
                     # with a superseded lease epoch is refused WHOLE —
-                    # the zombie-coordinator commit path ends here
-                    stale = self.leases.check_push_fences(fences)
+                    # the zombie-coordinator commit path ends here.  The
+                    # coordinator's CAS trace rode the body, so a reject
+                    # (and the merge's op_visible below) joins its trace.
+                    stale = self.leases.check_push_fences(fences,
+                                                          trace=trace)
                     if stale is not None:
                         self._send_bytes(
                             409,
@@ -1056,7 +1114,11 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                             "application/json")
                         return
                 try:
-                    fresh = self.node.receive(payload)
+                    if trace:
+                        with span("crdt.push", trace):
+                            fresh = self.node.receive(payload)
+                    else:
+                        fresh = self.node.receive(payload)
                 except (ValueError, KeyError, TypeError) as e:
                     self._send(400, f"malformed payload: "
                                     f"{type(e).__name__}: {e}")
@@ -1122,9 +1184,15 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                                     "ops={key:{expect,update}} "
                                     "(expect null = key must be absent)")
                     return
+                # the request's causal thread: header from external
+                # clients, body field across coordinator forwarding hops
+                # (the plane puts it there) — header wins when both ride
+                trace = self.headers.get(TRACE_HEADER) \
+                    or body.get("trace")
+                trace = None if trace is None else str(trace)
                 try:
                     token = plane.cas_multi(ops, timeout=timeout,
-                                            hops=hops)
+                                            hops=hops, trace=trace)
                 except CasConflict as e:
                     self._send_bytes(
                         409,

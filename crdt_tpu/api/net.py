@@ -46,7 +46,7 @@ from crdt_tpu.consistency.stability import (
     decode_summary,
 )
 from crdt_tpu.obs.events import EventLog
-from crdt_tpu.obs.trace import TRACE_HEADER, mint_trace_id
+from crdt_tpu.obs.trace import TRACE_HEADER, mint_trace_id, span
 from crdt_tpu.utils.config import ClusterConfig
 from crdt_tpu.utils.metrics import Metrics
 
@@ -226,6 +226,14 @@ class RemotePeer:
         """GET /ping (main.go:115-127)."""
         return self._get("/ping") is not None
 
+    def metrics_text(self) -> Optional[str]:
+        """GET /metrics as raw Prometheus text — the fleet rollup's
+        scrape path (obs/fleet via GET /fleet); rides the breaker like
+        every other call so a partitioned member is skipped, not hung
+        on."""
+        body = self._get("/metrics")
+        return None if body is None else body.decode("utf-8", "replace")
+
     @staticmethod
     def _parse(body: Optional[bytes]):
         """Decode a peer response; a peer serving corrupt bytes is treated
@@ -332,18 +340,22 @@ class RemotePeer:
 
     def ks_gossip(self, shard: int,
                   since: Optional[Dict[int, int]] = None,
+                  trace: Optional[str] = None,
                   ) -> Optional[Dict[str, Any]]:
         """GET /ks/gossip?shard=i[&vv=...]: one SHARD's delta payload
         plus its stability summary in the response BODY ({"payload",
         "vv", "frontier"}).  Body, not header: a round pulls several
         shards and the header slot (take_stability) holds only one
         summary.  Built on _get, so the nemesis fault plane and the
-        circuit breaker see it like any other pull."""
+        circuit breaker see it like any other pull.  ``trace`` rides
+        the X-CRDT-Trace header so the serve event joins the puller's
+        round in assembled traces, exactly like /gossip."""
         path = f"/ks/gossip?shard={int(shard)}"
         if since is not None:
             vv = json.dumps({str(r): s for r, s in since.items()})
             path += "&vv=" + urllib.parse.quote(vv)
-        return self._parse(self._get(path))
+        headers = {TRACE_HEADER: trace} if trace else None
+        return self._parse(self._get(path, headers=headers))
 
     def ks_compact(self, shard: int, frontier: Dict[int, int]) -> bool:
         """POST /ks/compact: fold ONE shard at/under ``frontier`` —
@@ -412,17 +424,22 @@ class RemotePeer:
         return got["body"]
 
     def push_fenced(self, payload: Dict[str, Any],
-                    fences: Dict[int, int]) -> Dict[str, Any]:
+                    fences: Dict[int, int],
+                    trace: Optional[str] = None) -> Dict[str, Any]:
         """POST /push with ``{slot: fence}`` stamps.  Returns
         ``{"ok": True}`` when the peer checked every stamp and merged;
         ``{"ok": False, "fenced": True, "slot", "fence"}`` when the peer
         refused a stale fence (naming its known one, so a zombie
         coordinator learns it was superseded); ``{"ok": False}`` on
-        transport failure / node down."""
-        got = self._post_json("/push", {
+        transport failure / node down.  ``trace`` travels in the body so
+        a fence refusal's cas_fenced_reject event joins the CAS trace."""
+        body: Dict[str, Any] = {
             "payload": payload,
             "fences": {str(s): int(f) for s, f in fences.items()},
-        })
+        }
+        if trace:
+            body["trace"] = trace
+        got = self._post_json("/push", body)
         if got is None:
             return {"ok": False}
         if got["status"] == 200:
@@ -883,25 +900,37 @@ class NetworkAgent:
         ks = self.keyspace
         if ks is None:
             return 0
+        # one trace id covers the whole multi-shard round: it rides the
+        # X-CRDT-Trace header of every shard's GET (the server's
+        # ks_gossip_serve events join it) and stamps the puller-side
+        # round events below — shard gossip shows up in assembled traces
+        # exactly like the host plane's pulls (ISSUE 16 satellite)
+        tid = mint_trace_id(self.node.rid)
         fresh_total = 0
         for i, shard in enumerate(ks.shards):
             since = shard.version_vector() \
                 if self.config.delta_gossip else None
-            body = peer.ks_gossip(i, since)
+            body = peer.ks_gossip(i, since, trace=tid)
             if body is None:
                 self.metrics.inc("net_ks_pull_skips")
+                self.node.events.emit("ks_pull_skip", trace=tid,
+                                      peer=peer.url, shard=i)
                 continue
             try:
                 payload = body.get("payload")
-                fresh = 0 if payload is None else shard.receive(payload)
+                with span("crdt.ks_pull", tid):
+                    fresh = 0 if payload is None else shard.receive(payload)
             except (ValueError, KeyError, TypeError) as e:
                 self.metrics.inc("net_ks_quarantined")
                 self.node.events.emit(
                     "payload_quarantine", surface="ks_gossip",
-                    peer=peer.url, shard=i,
+                    trace=tid, peer=peer.url, shard=i,
                     error=f"{type(e).__name__}: {e}")
                 continue
             fresh_total += fresh
+            self.node.events.emit(
+                "ks_pull_merge" if fresh else "ks_pull_noop",
+                trace=tid, peer=peer.url, shard=i, fresh=fresh)
             try:
                 vv = {int(r): int(s)
                       for r, s in (body.get("vv") or {}).items()}
@@ -925,13 +954,18 @@ class NetworkAgent:
         ks = self.keyspace
         if ks is None or not self.node.alive:
             return {}
+        # trace-stamped like ks_pull: the GC round (and any vv movement
+        # its folds cause) shows up as one joined group in assembled
+        # traces instead of anonymous leftovers
+        tid = mint_trace_id(self.node.rid)
         out: Dict[int, dict] = {}
         for i, tracker in enumerate(self.ks_trackers):
             frontier = tracker.mint(step=step)
             if not frontier:
                 self.metrics.inc("ks_gc_skipped")
                 continue
-            ks.compact_shard(i, frontier)
+            with span("crdt.ks_gc", tid):
+                ks.compact_shard(i, frontier)
             for p in self.peers:
                 if not p.backed_off():
                     p.ks_compact(i, frontier)
@@ -939,7 +973,7 @@ class NetworkAgent:
         if out:
             self.metrics.inc("ks_gc_rounds")
             self.node.events.emit(
-                "ks_gc",
+                "ks_gc", trace=tid,
                 shards={str(i): {str(r): s for r, s in f.items()}
                         for i, f in out.items()},
             )
@@ -1247,6 +1281,7 @@ class NodeHost:
         event_log: Optional[str] = None,
         step_clock=None,
         birth_ledger=None,
+        ks_birth_ledgers=None,
     ):
         from crdt_tpu.api.compositenode import CompositeNode
         from crdt_tpu.api.http_shim import _make_handler
@@ -1278,7 +1313,16 @@ class NodeHost:
         # flight recorder (crdt_tpu.obs.provenance): a soak harness passes
         # its shared BirthLedger + step clock so propagation-steps
         # histograms get a deterministic time base; installed BEFORE the
-        # boot event below so even boot carries a step stamp
+        # boot event below so even boot carries a step stamp.  The
+        # keyspace tier doesn't exist yet — install_flight_recorder is
+        # re-run after it's built so shard recorders get their per-shard
+        # ledgers (the host ledger CANNOT serve them: shards share the
+        # host's rid and seq-from-0 space, so one shared ledger would
+        # conflate planes; per-shard fleet-wide ledgers stay disjoint
+        # because shard i holds the same (rid, seq) space on every node)
+        self._ks_birth_ledgers = \
+            list(ks_birth_ledgers) if ks_birth_ledgers else None
+        self._step_clock = step_clock
         if step_clock is not None or birth_ledger is not None:
             self.install_flight_recorder(ledger=birth_ledger,
                                          step_clock=step_clock)
@@ -1320,6 +1364,11 @@ class NodeHost:
             rid, self.config, metrics=self.node.metrics,
             events=self.node.events,
         )
+        if self.keyspace is not None and (
+                step_clock is not None or self._ks_birth_ledgers):
+            # second pass now that the shards exist: wire the per-shard
+            # ledgers + step clock into the shard flight recorders
+            self.install_flight_recorder(step_clock=step_clock)
         # coordinator leases (crdt_tpu.consistency.leases): constructed
         # before the restore so persisted fence floors land back in it —
         # a crash-rebooted replica keeps refusing the stale fences it
@@ -1401,15 +1450,31 @@ class NodeHost:
         self._ckpt_err_lock = threading.Lock()
         self._ckpt_errors: List[Exception] = []
 
-    def install_flight_recorder(self, ledger=None, step_clock=None) -> None:
+    def install_flight_recorder(self, ledger=None, step_clock=None,
+                                ks_ledgers=None) -> None:
         """Attach a shared BirthLedger / step clock to this host's flight
         recorder (crdt_tpu.obs.provenance) and stamp subsequent events with
         the driver step.  Idempotent; soak harnesses call this (or pass the
         constructor kwargs) so propagation-steps lag uses their
-        deterministic time base."""
+        deterministic time base.
+
+        ``ks_ledgers`` is the keyspace tier's ledger list — ONE fleet-wide
+        BirthLedger per shard index (shards share the host rid + seq
+        space, so the host ledger must never serve them; shard i's space
+        is the same on every node, so per-index ledgers are exact)."""
         self.node.recorder.install(ledger=ledger, step_clock=step_clock)
         if step_clock is not None:
             self.node.events.step_clock = step_clock
+        if ks_ledgers is not None:
+            self._ks_birth_ledgers = list(ks_ledgers)
+        ks = getattr(self, "keyspace", None)
+        if ks is not None:
+            ledgers = self._ks_birth_ledgers
+            for i, shard in enumerate(ks.shards):
+                shard.recorder.install(
+                    ledger=ledgers[i]
+                    if ledgers and i < len(ledgers) else None,
+                    step_clock=step_clock)
 
     def start_server(self) -> None:
         """Serve the HTTP surface only (no background gossip) — for drivers

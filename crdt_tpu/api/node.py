@@ -663,9 +663,15 @@ class ReplicaNode:
             # no per-op scan; duplicate/reordered deliveries (vv did not
             # move) emit nothing, so exactly-once holds structurally
             epoch = self.clock.epoch_ms
+            cmds = None
+            if self.recorder.tenant_of is not None:
+                # tenant attribution (keyspace shards): hand the recorder
+                # the raw command rows so it can read each op's tenant
+                cmds = {(rid, seq): cmd for _, rid, seq, cmd in rows}
             self.recorder.note_visible(
                 vv_before, vv_after,
                 births={(rid, seq): ts + epoch for ts, rid, seq, _ in rows},
+                cmds=cmds,
             )
         return fresh + adopted
 
@@ -711,10 +717,14 @@ class ReplicaNode:
             # one vv delta covers the whole fused round: per (origin, seq)
             # the k payloads' duplicates collapse to one visibility
             epoch = self.clock.epoch_ms
+            cmds = None
+            if self.recorder.tenant_of is not None:
+                cmds = {(rid, seq): cmd for _, rid, seq, cmd in rows_all}
             self.recorder.note_visible(
                 vv_before, vv_after,
                 births={(rid, seq): ts + epoch
                         for ts, rid, seq, _ in rows_all},
+                cmds=cmds,
             )
         return fresh + adopted
 

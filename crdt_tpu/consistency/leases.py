@@ -51,6 +51,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from crdt_tpu.keyspace.routing import ranked_members
+from crdt_tpu.obs.trace import current_trace
 
 # gauge encoding for lease_state{slot} (obs/health.sample_leases):
 # ordered by degradation so alert rules can threshold
@@ -217,8 +218,11 @@ class LeaseManager:
                 return None
             if held["expires"] <= now:
                 del self._held[slot]
-                self.events.emit("lease_expire", slot=slot,
-                                 fence=held["fence"])
+                # trace-joined (current_trace is bound inside a CAS
+                # span): an expiry observed mid-request lands in that
+                # request's assembled trace, not as an orphan instant
+                self.events.emit("lease_expire", trace=current_trace(),
+                                 slot=slot, fence=held["fence"])
                 return None
             return held["fence"]
 
@@ -246,6 +250,10 @@ class LeaseManager:
                     held = self._held.get(slot)
                     if held is not None and held["fence"] == fence:
                         held["expires"] = self.clock() + self.duration
+                self.events.emit("lease_renew", trace=current_trace(),
+                                 slot=slot, fence=fence,
+                                 holder=self.own_url)
+                self.metrics.inc("lease_renewals")
             return fence
         proposed = self.fence_of(slot) + 1
         if not self._quorum_round(slot, proposed, renewal=False):
@@ -264,8 +272,8 @@ class LeaseManager:
             self._held[slot] = {"fence": proposed,
                                 "expires": self.clock() + self.duration}
             self._fences[slot] = max(self._fences.get(slot, 0), proposed)
-        self.events.emit("lease_grant", slot=slot, fence=proposed,
-                         holder=self.own_url)
+        self.events.emit("lease_grant", trace=current_trace(), slot=slot,
+                         fence=proposed, holder=self.own_url)
         self.metrics.inc("lease_grants")
         return proposed
 
@@ -303,21 +311,23 @@ class LeaseManager:
 
     # ---- replica side (POST /push fence check) ----
 
-    def check_push_fences(self,
-                          fences: Dict[int, int]) -> Optional[Dict]:
+    def check_push_fences(self, fences: Dict[int, int],
+                          trace: Optional[str] = None) -> Optional[Dict]:
         """Validate a push's fence stamps BEFORE merging.  Returns None
         when every stamp is current (higher stamps are adopted), else
         ``{"slot": s, "fence": known}`` for the first stale stamp — the
         handler refuses the whole push with that body, emits
         ``cas_fenced_reject``, and merges nothing (zombie-coordinator
-        firewall)."""
+        firewall).  ``trace`` is the pushing coordinator's CAS trace id
+        (rode the /push body), so the reject joins that request's
+        assembled trace across the process boundary."""
         for slot, fence in sorted(fences.items()):
             slot, fence = int(slot), int(fence)
             known = self.fence_of(slot)
             if fence < known:
                 self.metrics.inc("cas_fenced_rejects")
-                self.events.emit("cas_fenced_reject", slot=slot,
-                                 fence=fence, known=known)
+                self.events.emit("cas_fenced_reject", trace=trace,
+                                 slot=slot, fence=fence, known=known)
                 return {"slot": slot, "fence": known}
             self.note_fence(slot, fence)
         return None

@@ -57,6 +57,7 @@ from crdt_tpu.consistency.session import (
     vv_dominates,
     wait_for_dominance,
 )
+from crdt_tpu.obs.trace import current_trace, mint_trace_id, span
 
 LEVELS = ("eventual", "session", "bounded", "linearizable")
 
@@ -169,7 +170,11 @@ class ConsistencyPlane:
                      token: Optional[Dict[int, int]] = None,
                      ) -> ConsistencyUnavailable:
         self.metrics.inc("consistency_unavailable")
-        self.events.emit("consistency_unavailable", reason=reason,
+        # trace-joined when raised inside a CAS span (current_trace is
+        # bound there): the blame report can tie an unavailability burst
+        # to the lease churn / breaker state of the SAME request
+        self.events.emit("consistency_unavailable", trace=current_trace(),
+                         reason=reason,
                          level=level, op=op, acks=acks, quorum=quorum,
                          indeterminate=indeterminate,
                          **({"token": {str(r): s for r, s in token.items()}}
@@ -357,7 +362,7 @@ class ConsistencyPlane:
 
     def cas(self, key: str, expect: Optional[str], update: str,
             timeout: Optional[float] = None,
-            hops: int = 0) -> Dict[int, int]:
+            hops: int = 0, trace: Optional[str] = None) -> Dict[int, int]:
         """Compare-and-set: atomically replace ``key``'s value with
         ``update`` iff its linearizable-read value equals ``expect``
         (``expect=None`` = key must be absent).  Returns the session
@@ -374,11 +379,12 @@ class ConsistencyPlane:
         but fewer than a quorum acked the synchronous push (the op WILL
         still propagate via anti-entropy)."""
         return self.cas_multi({key: (expect, update)}, timeout=timeout,
-                              hops=hops)
+                              hops=hops, trace=trace)
 
     def cas_multi(self, ops: Dict[str, Tuple[Optional[str], str]],
                   timeout: Optional[float] = None,
-                  hops: int = 0) -> Dict[int, int]:
+                  hops: int = 0,
+                  trace: Optional[str] = None) -> Dict[int, int]:
         """Multi-key CAS batch: every ``key -> (expect, update)`` pair
         checked under ONE linearizable view and applied all-or-nothing
         (all pairs minted as a single command, so one op identity covers
@@ -390,31 +396,42 @@ class ConsistencyPlane:
         without a 2PC)."""
         if not ops:
             raise ValueError("cas_multi requires at least one key")
-        if self.leases is None:
-            return self._cas_decide(ops, fences=None, timeout=timeout)
-        slots = sorted({self.leases.slot_of(k) for k in ops})
-        # the batch coordinator is the FIRST sorted slot's coordinator —
-        # deterministic, so concurrent batches over the same slot set
-        # route to the same decider
-        coord = self.leases.coordinator_of(slots[0])
-        if coord != self.leases.own_url:
-            return self._cas_forward(coord, ops, timeout=timeout,
-                                     hops=hops)
-        fences: Dict[int, int] = {}
-        for slot in slots:
-            fence = self.leases.ensure(slot)
-            if fence is None:
-                peers = self._peers()
-                raise self._unavailable(
-                    "lease_unavailable", level="linearizable", op="cas",
-                    quorum=self._quorum_of(len(peers) + 1))
-            fences[slot] = fence
-        return self._cas_decide(ops, fences=fences, timeout=timeout)
+        # one trace id threads the whole request — minted here at the
+        # origin unless the HTTP surface already propagated one
+        # (X-CRDT-Trace across forwarding hops).  The span binds it as
+        # current_trace, so every lease event (grant/renew/expire) and
+        # unavailability raised underneath joins the same trace.
+        tid = trace or current_trace() or mint_trace_id(self.node.rid)
+        with span("crdt.cas", tid):
+            if self.leases is None:
+                return self._cas_decide(ops, fences=None, timeout=timeout,
+                                        trace=tid)
+            slots = sorted({self.leases.slot_of(k) for k in ops})
+            # the batch coordinator is the FIRST sorted slot's
+            # coordinator — deterministic, so concurrent batches over the
+            # same slot set route to the same decider
+            coord = self.leases.coordinator_of(slots[0])
+            if coord != self.leases.own_url:
+                return self._cas_forward(coord, ops, timeout=timeout,
+                                         hops=hops, trace=tid)
+            fences: Dict[int, int] = {}
+            for slot in slots:
+                fence = self.leases.ensure(slot)
+                if fence is None:
+                    peers = self._peers()
+                    raise self._unavailable(
+                        "lease_unavailable", level="linearizable",
+                        op="cas",
+                        quorum=self._quorum_of(len(peers) + 1))
+                fences[slot] = fence
+            return self._cas_decide(ops, fences=fences, timeout=timeout,
+                                    trace=tid)
 
     def _cas_forward(self, coord: str,
                      ops: Dict[str, Tuple[Optional[str], str]],
                      *, timeout: Optional[float],
-                     hops: int) -> Dict[int, int]:
+                     hops: int,
+                     trace: Optional[str] = None) -> Dict[int, int]:
         """Relay the batch to the routed coordinator.  The coordinator's
         verdict is re-raised HERE without re-emitting events/metrics —
         the deciding node already counted it, and the nemesis --strong
@@ -433,11 +450,18 @@ class ConsistencyPlane:
             raise self._unavailable("forward_unreachable",
                                     level="linearizable", op="cas")
         self.metrics.inc("cas_forwarded")
+        self.events.emit("cas_forward", trace=trace, coordinator=coord,
+                         hops=int(hops) + 1, keys=sorted(ops))
         body = {
             "ops": {k: {"expect": e, "update": u}
                     for k, (e, u) in ops.items()},
             "hops": int(hops) + 1,
         }
+        if trace:
+            # the causal thread crosses the hop: the coordinator's /cas
+            # handler re-binds this id, so its lease events and commit
+            # join the ORIGIN's trace in the assembled timeline
+            body["trace"] = trace
         if timeout is not None:
             body["timeout"] = float(timeout)
         got = peer.cas_forward(body)
@@ -474,7 +498,8 @@ class ConsistencyPlane:
 
     def _cas_decide(self, ops: Dict[str, Tuple[Optional[str], str]],
                     *, fences: Optional[Dict[int, int]],
-                    timeout: Optional[float]) -> Dict[int, int]:
+                    timeout: Optional[float],
+                    trace: Optional[str] = None) -> Dict[int, int]:
         """Decide the batch locally: linearizable view, expectation
         checks, one-command mint, fence-stamped synchronous write
         quorum.  ``fences=None`` is the legacy lease-less path (plain
@@ -526,7 +551,7 @@ class ConsistencyPlane:
                     if p.push_payload(payload):
                         acks += 1
                     continue
-                verdict = p.push_fenced(payload, fences)
+                verdict = p.push_fenced(payload, fences, trace=trace)
                 if verdict.get("ok"):
                     acks += 1
                 elif verdict.get("fenced") and self.leases is not None:
@@ -540,11 +565,15 @@ class ConsistencyPlane:
                 # decision provenance for the coordinator-crash oracle:
                 # a commit names its fence epochs, so the black boxes can
                 # prove no two nodes ever committed under the same
-                # (slot, fence) — the claim the whole lease design makes
+                # (slot, fence) — the claim the whole lease design makes.
+                # elapsed_ms feeds the blame report's CAS-latency-spike
+                # rule; the trace joins the commit to the origin's
+                # request across any forwarding hops it took.
                 self.events.emit(
-                    "cas_commit", keys=sorted(ops),
+                    "cas_commit", trace=trace, keys=sorted(ops),
                     fences={str(s): f for s, f in sorted(fences.items())},
-                    acks=acks)
+                    acks=acks,
+                    elapsed_ms=round((self.clock() - t0) * 1e3, 3))
             self.metrics.observe("strong_read_quorum_seconds",
                                  self.clock() - t0)
             self.metrics.inc("cas_applied")

@@ -199,6 +199,12 @@ class NemesisReport:
     mt_sheds: int = 0
     mt_shed_ops: int = 0
     mt_page_quarantines: int = 0
+    # fleet SLO rollup accounting (obs/fleet): per-tenant propagation
+    # coverage from the tenant-labeled flight-recorder series, and the
+    # slo_breach events the rollup recorded (reconciled 1:1 vs the
+    # ingest_shed provenance)
+    mt_prop_coverage: Optional[Dict[str, float]] = None
+    slo_breaches: int = 0
 
     def summary(self) -> str:
         faults = ", ".join(
@@ -237,6 +243,11 @@ class NemesisReport:
                      f"({self.mt_shed_ops} ops), "
                      f"{self.mt_page_quarantines} corrupt pages, "
                      f"provenance 1:1; ks gc emptied every shard log")
+        if self.mt_prop_coverage:
+            worst = min(self.mt_prop_coverage.values())
+            prop += (f"; per-tenant propagation coverage >= {worst:.2%} "
+                     f"({len(self.mt_prop_coverage)} tenants), "
+                     f"{self.slo_breaches} slo_breach event(s) reconciled")
         if self.strong_ok or self.strong_unavailable:
             prop += (f"; strong: {self.strong_ok} ok, "
                      f"{self.strong_unavailable} unavailable (1:1 events, "
@@ -316,6 +327,10 @@ class _Slot:
             # propagation-steps lag lines up exactly with the fault log
             step_clock=lambda: int(plane.step),
             birth_ledger=self.soak.ledger,
+            # keyspace shards get their own fleet-shared per-shard
+            # ledgers (None outside --multitenant): tenant-labeled
+            # propagation lag with the same exactly-once derivation
+            ks_birth_ledgers=self.soak.ks_ledgers,
         )
         # swap the agent's peer clients for fault-plane shims: every wire
         # interaction of the runtime under test now crosses the nemesis.
@@ -513,6 +528,14 @@ class NemesisSoak:
         # fleet-shared birth ledger: every slot's flight recorder converts
         # newly-visible seqs to step lags against it (obs/provenance)
         self.ledger = BirthLedger()
+        # keyspace tier: one fleet-shared ledger PER SHARD — shard i
+        # holds the same (rid, seq) space on every node (and reuses the
+        # host plane's rid + seq-from-0 space), so per-shard ledgers keep
+        # the ranges disjoint without any dedup table
+        self.ks_ledgers = [BirthLedger() for _ in range(self.MT_SHARDS)] \
+            if multitenant else None
+        # last fleet SLO rollup (obs/fleet), kept for the postmortem
+        self._fleet_report = None
         ingest_kw = {}
         if overload:
             # the shed point must be REACHABLE: flush-on-size drains at
@@ -1789,6 +1812,13 @@ class NemesisSoak:
         if self.multitenant:
             self._check_multitenant_oracle()
             self._mt_gc_final()
+            # fleet SLO rollup over the converged fleet, then the two
+            # observability gates it feeds: per-tenant propagation
+            # coverage (the MT mirror of --assemble-check) and the
+            # slo_breach <-> ingest_shed 1:1 reconciliation
+            self._fleet_rollup(emit_events=True)
+            self._check_mt_propagation()
+            self._check_slo_accounting()
         self._check_prefix_oracle()
         self._check_idempotence()
         self._check_quarantine_provenance()
@@ -1850,6 +1880,90 @@ class NemesisSoak:
                 "sampler not wired"
             )
 
+    def _fleet_rollup(self, emit_events: bool = False):
+        """Fold every live member's Prometheus exposition into the fleet
+        SLO view (obs/fleet) — the same code path as ``GET /fleet`` and
+        ``python -m crdt_tpu.obs fleet``.  With ``emit_events`` the SLO
+        threshold crossings land as first-class ``slo_breach`` records
+        in the first live node's black box (so the postmortem and the
+        reconciliation both see them)."""
+        from crdt_tpu.obs import fleet as fleet_lib
+
+        texts = {}
+        for s in self.slots:
+            if not s.alive:
+                continue
+            h = s.host
+            texts[str(h.node.rid)] = health.render_node_metrics(
+                h.node, agent=h.agent, ingest=h.ingest,
+                stability=getattr(h.agent, "stability", None),
+                keyspace=h.keyspace, ks_door=h.ks_door, leases=h.leases)
+        if not texts:
+            return None
+        events = None
+        if emit_events:
+            live = next((s for s in self.slots if s.alive), None)
+            if live is not None:
+                events = live.host.node.events
+        self._fleet_report = fleet_lib.fleet_from_texts(
+            texts, events=events)
+        return self._fleet_report
+
+    def _check_mt_propagation(self, min_coverage: float = 0.95) -> None:
+        """Per-tenant flight-recorder coverage gate: every tenant's
+        admitted ops must show up as tenant-labeled propagation
+        observations on >= min_coverage of the ``ops x (nodes-1)``
+        expected remote visibilities.  The vv-delta derivation is
+        exactly-once, so coverage can never legitimately exceed 1.0 —
+        a shortfall is MISSING provenance and an excess is a duplicate-
+        counting bug, and both fail loudly."""
+        rollup = self._fleet_report
+        assert rollup is not None, "fleet rollup unavailable (no live member)"
+        coverage: Dict[str, float] = {}
+        for t in (*self.MT_TENANTS, self.MT_NOISY):
+            row = rollup["tenants"].get(t)
+            assert row is not None and row["ops"] > 0, (
+                f"tenant {t!r} admitted no ops; MT schedule dead?")
+            cov = row["prop_coverage"]
+            assert cov is not None and cov >= min_coverage, (
+                f"tenant {t!r} propagation coverage {cov} < {min_coverage}"
+                f": observed {row['prop_observed']} of "
+                f"{row['prop_expected']} expected visibilities")
+            assert cov <= 1.0 + 1e-9, (
+                f"tenant {t!r} propagation coverage {cov} > 1: the "
+                "vv-delta exactly-once derivation double-counted")
+            coverage[t] = cov
+        self.report.mt_prop_coverage = coverage
+
+    def _check_slo_accounting(self) -> None:
+        """slo_breach <-> ingest_shed 1:1: the noisy tenant's forced
+        quota sheds must surface as a ``shed_ratio`` SLO breach whose
+        ``n_sheds`` equals the count of that tenant's ``ingest_shed``
+        provenance events across every node's log — same source, two
+        sinks, so any drift is a lost record."""
+        from crdt_tpu.obs import fleet as fleet_lib
+
+        rollup = self._fleet_report
+        assert rollup is not None, "fleet rollup unavailable (no live member)"
+        breaches = rollup.get("slo_breaches", [])
+        noisy = [b for b in breaches
+                 if b.get("tenant") == self.MT_NOISY
+                 and b.get("kind") == "shed_ratio"]
+        assert noisy, (
+            f"noisy tenant {self.MT_NOISY!r} tripped its quota but no "
+            f"shed_ratio slo_breach was recorded (breaches: {breaches})")
+        records = assemble.load_node_logs(
+            [s.event_log_path for s in self.slots])
+        rec = fleet_lib.reconcile_sheds(breaches, records)
+        row = rec["tenants"].get(self.MT_NOISY)
+        assert row is not None and row["ok"], (
+            f"slo_breach shed accounting does not reconcile with "
+            f"ingest_shed provenance: {rec}")
+        # the crossing is ALSO a first-class event in the black box
+        assert any(e.get("event") == "slo_breach" for e in records), (
+            "slo_breach evaluated but never landed in a node's event log")
+        self.report.slo_breaches = len(breaches)
+
     def _check_assembly(self, min_coverage: float = 0.95) -> None:
         """The flight-recorder CI gate: assemble the fleet's JSONL logs
         into one Perfetto timeline and require the blame report to explain
@@ -1890,10 +2004,20 @@ class NemesisSoak:
             return None
         out = str(pathlib.Path(self.postmortem_dir)
                   / f"postmortem-{self.seed}.tar.gz")
+        rollup = self._fleet_report
+        if rollup is None:
+            # best-effort: a failure before heal_and_check still gets
+            # the point-in-time fleet view of whoever is alive
+            try:
+                rollup = self._fleet_rollup()
+            except Exception:
+                rollup = None
         try:
             assemble.write_postmortem(
                 out, [s.event_log_path for s in self.slots],
                 fault_records=self.plane.log,
+                extra={"fleet.json": rollup} if rollup is not None
+                else None,
             )
         except OSError as e:
             print(f"[nemesis] postmortem bundling failed: {e}")

@@ -102,7 +102,9 @@ class AdmissionQueue:
         self.node = str(node)
         self._lock = threading.Lock()          # queue state
         self._drain_lock = threading.Lock()    # serializes flush_fn calls
-        self._pending: List[Tuple[List[Any], Ticket, float]] = []
+        # (items, ticket, enqueue time, tenant-or-None) per group
+        self._pending: List[Tuple[List[Any], Ticket, float,
+                                  Optional[str]]] = []
         self._depth = 0
         self._oldest: Optional[float] = None
 
@@ -136,7 +138,7 @@ class AdmissionQueue:
                                        self.metrics, self.events, self.node,
                                        tenant=tenant)
             ticket = Ticket(self)
-            self._pending.append((items, ticket, now))
+            self._pending.append((items, ticket, now, tenant))
             self._depth += len(items)
             if self._oldest is None:
                 self._oldest = now
@@ -169,7 +171,7 @@ class AdmissionQueue:
                     "ingest_queue_depth", 0.0,
                     lane=self.name, node=self.node)
             flat: List[Any] = []
-            for items, _, _ in batch:
+            for items, _, _, _ in batch:
                 flat.extend(items)
             reg = self.metrics.registry
             t0 = time.monotonic()
@@ -180,7 +182,7 @@ class AdmissionQueue:
                 if self.events is not None:
                     self.events.emit("ingest_drain_error", lane=self.name,
                                      n_ops=len(flat), error=repr(exc))
-                for _, ticket, _ in batch:
+                for _, ticket, _, _ in batch:
                     ticket._resolve(None, exc)
                 return len(flat)
             t1 = time.monotonic()
@@ -197,13 +199,19 @@ class AdmissionQueue:
             # admit latency = enqueue -> drain completion, per group (the
             # flight recorder attributes the in-node half; this histogram
             # is the front-door half the bench reports)
-            for _, _, t_enq in batch:
+            for _, _, t_enq, tenant in batch:
                 reg.observe("ingest_admit_latency", t1 - t_enq,
                             lane=self.name, node=self.node)
+                if tenant is not None:
+                    # the per-tenant SLO view's admit column (obs/fleet):
+                    # a SEPARATE series so the {lane,node} one above
+                    # keeps its label set (dashboards, benches)
+                    reg.observe("ks_admit_latency", t1 - t_enq,
+                                tenant=tenant, node=self.node)
             reg.observe("ingest_drain_seconds", t1 - t0,
                         lane=self.name, node=self.node)
             off = 0
-            for items, ticket, _ in batch:
+            for items, ticket, _, _ in batch:
                 ticket._resolve(results[off:off + len(items)], None)
                 off += len(items)
             return len(flat)

@@ -106,6 +106,17 @@ class KeyspaceFrontDoor:
             for tenant, n in drained.items():
                 reg.inc("keyspace_tenant_ops", float(n), tenant=tenant,
                         node=self.node)
+            if self.events is not None and reg.enabled:
+                # per-drain birth provenance: which tenants this drain
+                # minted how many ops for, joined to the shard recorder's
+                # op_births record by (shard, seq range).  ONE event per
+                # drain — the per-op emission cost stays amortized, and
+                # offline tooling (assemble/fleet) gets per-tenant
+                # expected counts without a dedup table.
+                self.events.emit(
+                    "ks_births", shard=shard, n=len(items),
+                    seq_first=int(idents[0][1]), seq_last=int(idents[-1][1]),
+                    tenants=drained)
             return idents
         return flush
 

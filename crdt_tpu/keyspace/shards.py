@@ -49,6 +49,18 @@ def split_qualified(qkey: str) -> Tuple[str, str]:
     return tenant, key
 
 
+def tenant_of_cmd(cmd: Dict[str, str]) -> Optional[str]:
+    """Tenant of one shard-local command: the :func:`qualify` prefix of
+    its first key.  Every key the front door admits is tenant-qualified;
+    a bare key (a direct shard poke in tests) has no tenant and gets
+    none.  The flight recorder's merge side calls this per newly-visible
+    op to label the propagation histograms (obs/provenance)."""
+    for qkey in cmd:
+        tenant, sep, _ = qkey.partition(QUALIFY_SEP)
+        return tenant if sep else None
+    return None
+
+
 class ShardedKeyspace:
     """S independent plane shards + the deterministic router over them."""
 
@@ -72,6 +84,16 @@ class ShardedKeyspace:
                         clock=clock, events=events)
             for _ in range(n_shards)
         ]
+        # per-shard flight-recorder identity: shards share the host's rid
+        # AND its seq-from-0 space, so their op_birth/op_visible records
+        # (and propagation series) must carry the shard label to stay
+        # disjoint from the host plane's and each other's.  tenant_of
+        # turns each merged op's qualified key into a tenant label — the
+        # ISSUE-16 per-{tenant,shard} propagation view, derived at merge
+        # time with zero wire change.
+        for i, shard in enumerate(self.shards):
+            shard.recorder.bind(extra={"shard": str(i)},
+                                tenant_of=tenant_of_cmd)
         # level-1 interning: tenant -> small id (accounting only — ids
         # are NEVER stored or gossiped; arrival order may differ per node)
         self._tenants: Dict[str, int] = {}

@@ -1,4 +1,5 @@
-"""CLI entry: ``python -m crdt_tpu.obs assemble <logs...>``."""
+"""CLI entry: ``python -m crdt_tpu.obs assemble <logs...>`` and
+``python -m crdt_tpu.obs fleet <members...>``."""
 from __future__ import annotations
 
 import sys
@@ -9,15 +10,22 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m crdt_tpu.obs assemble <node.jsonl ...> "
               "[--fault-log F] [--out trace.json] [--blame blame.json] "
-              "[--min-coverage 0.95]")
+              "[--min-coverage 0.95]\n"
+              "       python -m crdt_tpu.obs fleet <url-or-file ...> "
+              "[--logs node.jsonl ...] [--min-coverage 95] "
+              "[--out fleet.json]")
         return 0 if argv else 2
     cmd = argv.pop(0)
-    if cmd != "assemble":
-        print(f"unknown subcommand {cmd!r} (only: assemble)")
-        return 2
-    from crdt_tpu.obs.assemble import main as assemble_main
+    if cmd == "assemble":
+        from crdt_tpu.obs.assemble import main as assemble_main
 
-    return assemble_main(argv)
+        return assemble_main(argv)
+    if cmd == "fleet":
+        from crdt_tpu.obs.fleet import main as fleet_main
+
+        return fleet_main(argv)
+    print(f"unknown subcommand {cmd!r} (only: assemble, fleet)")
+    return 2
 
 
 if __name__ == "__main__":

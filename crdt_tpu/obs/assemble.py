@@ -46,9 +46,35 @@ RID_STRIDE = 64
 SPIKE_FLOOR = 12
 SPIKE_MULTIPLIER = 4.0
 
-# puller-side events that terminate a gossip-round span, by severity
-_ROUND_EVENTS = ("pull_merge", "pull_merge_fused", "pull_noop",
-                 "payload_quarantine", "pull_skip")
+# puller-side events that terminate a gossip-round span, by severity.
+# The keyspace tier's rounds (ks_pull_*) are the same shape as the host
+# plane's — one trace ID per round, a serve event on the far side — so
+# they fold into the same span machinery.
+_ROUND_EVENTS = ("pull_merge", "pull_merge_fused", "ks_pull_merge",
+                 "pull_noop", "ks_pull_noop", "payload_quarantine",
+                 "pull_skip", "ks_pull_skip")
+
+# serve-side events a round's flow arrow can anchor on
+_SERVE_EVENTS = ("gossip_serve", "ks_gossip_serve")
+
+# the per-slot lease track renders these (fence epoch as a counter,
+# grants/expiries/rejects as instants, handoffs as flow arrows)
+_LEASE_EVENTS = ("lease_grant", "lease_renew", "lease_expire",
+                 "cas_fenced_reject")
+
+# CAS latency spikes: elapsed_ms > max(floor, multiplier * median); the
+# floor keeps an idle plane (sub-ms commits) from flagging noise
+CAS_SPIKE_FLOOR_MS = 50.0
+
+# consistency_unavailable events closer than this (steps when stamped,
+# else wall ms) coalesce into one burst for attribution
+BURST_GAP_STEPS = 2
+BURST_GAP_MS = 1000
+
+# lease grant/expire churn within this many steps (or ms) of a strong-path
+# event counts as overlapping churn for the blame rules
+CHURN_WINDOW_STEPS = 2
+CHURN_WINDOW_MS = 1000
 
 
 def load_node_logs(paths: List[str]) -> List[Dict[str, Any]]:
@@ -108,13 +134,23 @@ def assemble_trace(records: List[Dict[str, Any]],
         {_slot(r.get("node", "?"), stride) for r in records},
         key=lambda s: (len(s), s),
     )
-    # tid 0 is the nemesis overlay track; node slots start at 1
+    # tid 0 is the nemesis overlay track; node slots start at 1, then one
+    # track per lease slot (the strong path's per-slot timeline)
     tids = {slot: i + 1 for i, slot in enumerate(slots)}
     events.append({"ph": "M", "name": "thread_name", "pid": pid, "tid": 0,
                    "args": {"name": "nemesis (applied faults)"}})
     for slot, tid in tids.items():
         events.append({"ph": "M", "name": "thread_name", "pid": pid,
                        "tid": tid, "args": {"name": f"node slot {slot}"}})
+    lease_slots = sorted(
+        {str(r["slot"]) for r in records
+         if r.get("event") in _LEASE_EVENTS and r.get("slot") is not None},
+        key=lambda s: (len(s), s),
+    )
+    lease_tids = {s: len(tids) + 1 + i for i, s in enumerate(lease_slots)}
+    for slot, tid in lease_tids.items():
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": f"lease slot {slot}"}})
 
     by_trace: Dict[str, List[Dict[str, Any]]] = {}
     for r in records:
@@ -147,7 +183,8 @@ def assemble_trace(records: List[Dict[str, Any]],
             "ts": t0 * 1000, "dur": max((t1 - t0) * 1000, 1),
             "args": dict(args_of(outcome), trace=trace_id),
         })
-        serve = next((r for r in group if r["event"] == "gossip_serve"), None)
+        serve = next((r for r in group if r["event"] in _SERVE_EVENTS),
+                     None)
         if serve is not None:
             flow += 1
             spanned_ids.add(id(serve))
@@ -158,6 +195,49 @@ def assemble_trace(records: List[Dict[str, Any]],
             events.append({"ph": "f", "bp": "e", "name": "gossip",
                            "cat": "gossip", "id": flow, "pid": pid,
                            "tid": tid, "ts": t1 * 1000 + 1})
+
+    # per-slot lease track: the fence epoch as a counter series (a step
+    # function that must be monotone — any dip on the rendered track IS
+    # a fencing bug), grants/renewals/expiries/rejects as instants, and
+    # every handoff (consecutive grants of one slot by different nodes)
+    # as a flow arrow between the two holders' node tracks
+    last_grant: Dict[str, Dict[str, Any]] = {}
+    for r in sorted((r for r in records
+                     if r.get("event") in _LEASE_EVENTS
+                     and r.get("slot") is not None and "ts_ms" in r),
+                    key=lambda r: r.get("ts_ms", 0)):
+        slot = str(r["slot"])
+        tid = lease_tids[slot]
+        ts = r["ts_ms"] * 1000
+        fence = r.get("fence")
+        # the counter tracks the slot's highest KNOWN fence: a fenced
+        # reject carries the zombie's stale stamp in `fence` and the
+        # rejecting node's current epoch in `known` — plotting the stale
+        # one would saw-tooth a monotone quantity
+        if r.get("known") is not None:
+            fence = max(int(fence or 0), int(r["known"]))
+        if fence is not None:
+            events.append({"ph": "C", "name": f"lease fence s{slot}",
+                           "pid": pid, "tid": tid, "ts": ts,
+                           "args": {"fence": int(fence)}})
+        events.append({"ph": "i", "s": "t", "name": r["event"],
+                       "pid": pid, "tid": tid, "ts": ts,
+                       "args": args_of(r)})
+        if r["event"] == "lease_grant":
+            prev = last_grant.get(slot)
+            if prev is not None and prev.get("node") != r.get("node"):
+                flow += 1
+                events.append({
+                    "ph": "s", "name": "lease_handoff", "cat": "lease",
+                    "id": flow, "pid": pid,
+                    "tid": tids[_slot(prev.get("node", "?"), stride)],
+                    "ts": prev.get("ts_ms", r["ts_ms"]) * 1000})
+                events.append({
+                    "ph": "f", "bp": "e", "name": "lease_handoff",
+                    "cat": "lease", "id": flow, "pid": pid,
+                    "tid": tids[_slot(r.get("node", "?"), stride)],
+                    "ts": ts + 1})
+            last_grant[slot] = r
 
     # everything not folded into a span: instant events on the node track
     for r in records:
@@ -192,17 +272,22 @@ def assemble_trace(records: List[Dict[str, Any]],
 # ---- blame report ----
 
 def _visible_lag(rec: Dict[str, Any],
-                 births: Dict[Tuple[int, int], int]) -> Optional[int]:
+                 births: Dict[Tuple[Any, Any, Any], int]) -> Optional[int]:
     """Step lag of one op_visible record: the recorder's own max
     (``lag_steps``), else derived from the oldest seq in the range (the
-    op that waited longest) against the op_birth records."""
+    op that waited longest) against the op_birth records.  Births are
+    keyed (origin, seq, shard-or-None): the keyspace shards reuse the
+    host plane's rid + seq-from-0 space, so the shard label is the
+    disambiguator that keeps a shard birth from answering for a host op
+    (and vice versa)."""
     lag = rec.get("lag_steps")
     if lag is not None:
         return int(lag)
     step = rec.get("step")
     if step is None:
         return None
-    born = births.get((rec.get("origin"), rec.get("seq_lo")))
+    born = births.get(
+        (rec.get("origin"), rec.get("seq_lo"), rec.get("shard")))
     if born is None:
         return None
     return max(0, int(step) - born)
@@ -254,6 +339,126 @@ def _explain(window: Tuple[int, int], origin_slot: str, observer_slot: str,
     return None
 
 
+def _near(rec: Dict[str, Any], other: Dict[str, Any],
+          steps: int, ms: int) -> bool:
+    """True when two records are close enough to interact: within
+    ``steps`` driver steps when both are step-stamped (the deterministic
+    soak case), else within ``ms`` wall ms."""
+    s0, s1 = rec.get("step"), other.get("step")
+    if s0 is not None and s1 is not None:
+        return abs(int(s0) - int(s1)) <= steps
+    t0, t1 = rec.get("ts_ms"), other.get("ts_ms")
+    if t0 is not None and t1 is not None:
+        return abs(int(t0) - int(t1)) <= ms
+    return False
+
+
+def _explain_strong(rec: Dict[str, Any],
+                    fault_records: List[Dict[str, Any]],
+                    records: List[Dict[str, Any]],
+                    stride: int) -> Optional[Dict[str, Any]]:
+    """Attribution rules for a strong-path anomaly (CAS latency spike or
+    consistency_unavailable burst), in evidence order: an applied fault
+    window over the event's step, overlapping lease churn (a grant /
+    expiry racing the request — handoff storms serialize CAS behind
+    quorum re-grants), or an open breaker (peer_backoff_skip — the
+    quorum was short a voter)."""
+    step = rec.get("step")
+    if step is not None:
+        for f in fault_records:
+            fstep, kind = f.get("step"), f.get("fault")
+            if fstep is None or kind in (None, "heal"):
+                continue
+            if int(step) - CHURN_WINDOW_STEPS <= fstep <= int(step):
+                return {"kind": kind, "step": fstep,
+                        **{k: f[k] for k in ("src", "dst", "node", "op")
+                           if k in f}}
+    for r in records:
+        ev = r.get("event")
+        if ev in ("lease_grant", "lease_expire") \
+                and _near(rec, r, CHURN_WINDOW_STEPS, CHURN_WINDOW_MS):
+            return {"kind": "lease_churn", "event": ev,
+                    "slot": r.get("slot"), "fence": r.get("fence"),
+                    "node": _slot(r.get("node", "?"), stride)}
+    for r in records:
+        if r.get("event") == "peer_backoff_skip" \
+                and _near(rec, r, CHURN_WINDOW_STEPS, CHURN_WINDOW_MS):
+            return {"kind": "breaker_open",
+                    "node": _slot(r.get("node", "?"), stride),
+                    "peer": r.get("peer")}
+    return None
+
+
+def _strong_path_report(records: List[Dict[str, Any]],
+                        fault_records: List[Dict[str, Any]],
+                        stride: int,
+                        spike_multiplier: float) -> Dict[str, Any]:
+    """CAS latency spikes and consistency_unavailable bursts, each
+    attributed through :func:`_explain_strong` — same contract as the
+    propagation spikes: everything above threshold is listed, explained
+    or flagged, and the per-section coverage is an honest rate."""
+    commits = [r for r in records
+               if r.get("event") == "cas_commit"
+               and r.get("elapsed_ms") is not None]
+    out: Dict[str, Any] = {
+        "n_cas_commits": len(commits),
+        "cas_spikes": [],
+        "cas_coverage": 1.0,
+    }
+    if commits:
+        median = statistics.median(float(r["elapsed_ms"]) for r in commits)
+        threshold = max(CAS_SPIKE_FLOOR_MS,
+                        spike_multiplier * max(median, 1.0))
+        out["cas_median_ms"] = median
+        out["cas_threshold_ms"] = threshold
+        for r in commits:
+            if float(r["elapsed_ms"]) <= threshold:
+                continue
+            cause = _explain_strong(r, fault_records, records, stride)
+            out["cas_spikes"].append({
+                "node": _slot(r.get("node", "?"), stride),
+                "keys": r.get("keys"),
+                "elapsed_ms": float(r["elapsed_ms"]),
+                "trace": r.get("trace"),
+                "cause": cause if cause is not None else "unexplained",
+            })
+    out["n_cas_spikes"] = len(out["cas_spikes"])
+    explained = sum(1 for s in out["cas_spikes"]
+                    if s["cause"] != "unexplained")
+    out["cas_coverage"] = (explained / out["n_cas_spikes"]
+                           if out["cas_spikes"] else 1.0)
+
+    unavail = sorted(
+        (r for r in records if r.get("event") == "consistency_unavailable"),
+        key=lambda r: (r.get("ts_ms", 0), r.get("step", 0) or 0))
+    bursts: List[List[Dict[str, Any]]] = []
+    for r in unavail:
+        if bursts and _near(bursts[-1][-1], r,
+                            BURST_GAP_STEPS, BURST_GAP_MS):
+            bursts[-1].append(r)
+        else:
+            bursts.append([r])
+    out["n_unavailable"] = len(unavail)
+    out["unavailable_bursts"] = []
+    for burst in bursts:
+        head = burst[0]
+        cause = _explain_strong(head, fault_records, records, stride)
+        out["unavailable_bursts"].append({
+            "n": len(burst),
+            "t0_ms": head.get("ts_ms"),
+            "t1_ms": burst[-1].get("ts_ms"),
+            "reasons": sorted({str(r.get("reason")) for r in burst}),
+            "nodes": sorted({_slot(r.get("node", "?"), stride)
+                             for r in burst}),
+            "cause": cause if cause is not None else "unexplained",
+        })
+    nb = len(out["unavailable_bursts"])
+    out["burst_coverage"] = (
+        sum(1 for b in out["unavailable_bursts"]
+            if b["cause"] != "unexplained") / nb if nb else 1.0)
+    return out
+
+
 def blame_report(records: List[Dict[str, Any]],
                  fault_records: Optional[List[Dict[str, Any]]] = None,
                  stride: int = RID_STRIDE,
@@ -265,10 +470,11 @@ def blame_report(records: List[Dict[str, Any]],
     ``"cause": "unexplained"`` — nothing is silently dropped, so
     ``coverage`` (explained/total) is an honest attribution rate."""
     fault_records = fault_records or []
-    births: Dict[Tuple[int, int], int] = {}
+    births: Dict[Tuple[Any, Any, Any], int] = {}
     for r in records:
         if r.get("event") == "op_birth" and r.get("step") is not None:
-            births[(r.get("origin"), r.get("seq"))] = int(r["step"])
+            births[(r.get("origin"), r.get("seq"),
+                    r.get("shard"))] = int(r["step"])
 
     lags: List[Tuple[int, Dict[str, Any]]] = []
     for r in records:
@@ -287,6 +493,10 @@ def blame_report(records: List[Dict[str, Any]],
         "n_explained": 0,
         "coverage": 1.0,
     }
+    # strong-path sections (CAS spikes / unavailability bursts) stand on
+    # their own evidence — they report even when no op ever propagated
+    report.update(_strong_path_report(records, fault_records, stride,
+                                      spike_multiplier))
     if not lags:
         report["median_lag_steps"] = None
         report["threshold_steps"] = None
@@ -331,10 +541,14 @@ def blame_report(records: List[Dict[str, Any]],
 
 def write_postmortem(out_path: str, node_log_paths: List[str],
                      fault_records: Optional[List[Dict[str, Any]]] = None,
-                     stride: int = RID_STRIDE) -> str:
+                     stride: int = RID_STRIDE,
+                     extra: Optional[Dict[str, Any]] = None) -> str:
     """Bundle the whole forensic record of a failed run into one tar.gz:
     every per-node JSONL log, the applied-fault log, the assembled
-    Perfetto trace, and the blame report.  Returns the bundle path."""
+    Perfetto trace, and the blame report.  ``extra`` adds caller
+    artifacts by archive name (str / bytes / JSON-able object — the
+    nemesis soak drops its fleet SLO rollup in as ``fleet.json``).
+    Returns the bundle path."""
     records = load_node_logs(node_log_paths)
     trace = assemble_trace(records, fault_records, stride=stride)
     blame = blame_report(records, fault_records, stride=stride)
@@ -370,6 +584,15 @@ def write_postmortem(out_path: str, node_log_paths: List[str],
                   json.dumps(trace, sort_keys=True).encode())
         add_bytes(tf, "blame.json",
                   json.dumps(blame, indent=2, sort_keys=True).encode())
+        for name, payload in (extra or {}).items():
+            if isinstance(payload, bytes):
+                data = payload
+            elif isinstance(payload, str):
+                data = payload.encode()
+            else:
+                data = json.dumps(payload, indent=2,
+                                  sort_keys=True).encode()
+            add_bytes(tf, name, data)
     return str(out)
 
 

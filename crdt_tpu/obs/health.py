@@ -171,6 +171,12 @@ def sample_keyspace(registry, node_label: str, keyspace,
         for tenant, depth in ks_door.tenant_depths().items():
             registry.set_gauge("keyspace_tenant_depth", float(depth),
                                tenant=tenant, node=node_label)
+        # quota slices, so the fleet rollup (obs/fleet) can report shed
+        # ratio AGAINST the mark that did the shedding
+        quotas = getattr(ks_door.policy, "tenant_high_water", None) or {}
+        for tenant, mark in quotas.items():
+            registry.set_gauge("keyspace_tenant_quota", float(mark),
+                               tenant=tenant, node=node_label)
 
 
 def sample_peer_circuits(registry, node_label: str, peers) -> None:

@@ -95,10 +95,32 @@ class FlightRecorder:
         self.events = events
         self.ledger: Optional[BirthLedger] = None
         self.step_clock: Optional[Callable[[], int]] = None
+        # tier labels (keyspace shards bind {"shard": "i"}): stamped onto
+        # every propagation observation AND every op_birth/op_visible
+        # event this recorder emits, so per-shard series never collide
+        # with the host plane's (which shares the rid and seq space)
+        self.extra: Dict[str, str] = {}
+        # tenant extractor: cmd dict -> tenant name (or None).  When set,
+        # the merge side labels each newly-visible op's observation with
+        # its tenant — derived from the op row itself, no wire change
+        self.tenant_of: Optional[
+            Callable[[Dict[str, str]], Optional[str]]] = None
 
     @property
     def enabled(self) -> bool:
         return bool(getattr(self.registry, "enabled", False))
+
+    def bind(self, extra: Optional[Dict[str, str]] = None,
+             tenant_of: Optional[
+                 Callable[[Dict[str, str]], Optional[str]]] = None) -> None:
+        """Attach tier labels / a tenant extractor (the sharded keyspace
+        binds ``{"shard": str(i)}`` + the qualified-key tenant splitter).
+        The host plane never calls this, so its label sets — and the
+        recorder's per-op cost there — are exactly what they were."""
+        if extra is not None:
+            self.extra = {str(k): str(v) for k, v in extra.items()}
+        if tenant_of is not None:
+            self.tenant_of = tenant_of
 
     def install(self, ledger: Optional[BirthLedger] = None,
                 step_clock: Optional[Callable[[], int]] = None) -> None:
@@ -127,7 +149,7 @@ class FlightRecorder:
             self.ledger.note(self.rid, seq, step)
         if self.events is not None:
             self.events.emit("op_birth", origin=self.rid, seq=seq,
-                             op_ts_ms=int(op_ts_ms))
+                             op_ts_ms=int(op_ts_ms), **self.extra)
 
     def note_births(self, births: Sequence[Tuple[int, int]]) -> None:
         """Batched birth stamp for one admission drain: every (seq,
@@ -147,14 +169,17 @@ class FlightRecorder:
                 "op_births", origin=self.rid, n=len(births),
                 seq_first=int(births[0][0]), seq_last=int(births[-1][0]),
                 op_ts_ms_first=int(births[0][1]),
-                op_ts_ms_last=int(births[-1][1]))
+                op_ts_ms_last=int(births[-1][1]), **self.extra)
 
     # ---- merge side ----
 
     def note_visible(self, vv_before: Dict[int, int],
                      vv_after: Dict[int, int],
                      births: Optional[Dict[Tuple[int, int], int]] = None,
-                     trace: Optional[str] = None) -> int:
+                     trace: Optional[str] = None,
+                     cmds: Optional[
+                         Dict[Tuple[int, int], Dict[str, str]]] = None,
+                     ) -> int:
         """Derive the newly-visible origin-seq ranges from the vv delta of
         one merge and record them: one ``op_visible`` event per origin
         range, one histogram observation per (origin, seq).
@@ -162,11 +187,16 @@ class FlightRecorder:
         ``births`` maps ``(origin, seq) -> wire ts (absolute ms)`` for the
         ops that arrived as raw rows this round (seqs that became visible
         through a compaction-frontier adoption have no row; they get the
-        event and the step lag but no seconds observation).  Returns the
-        number of newly-visible ops."""
+        event and the step lag but no seconds observation).  ``cmds``
+        maps the same idents to their raw command dicts; a bound
+        ``tenant_of`` reads the tenant off each one, so tenant labels
+        exist only on recorders that asked for them.  Returns the number
+        of newly-visible ops."""
         now_ms = int(time.time() * 1000)
         step = self._now_step()
         tid = trace if trace is not None else current_trace()
+        extra = self.extra
+        tenant_of = self.tenant_of
         total = 0
         for origin in sorted(vv_after):
             hi = vv_after[origin]
@@ -177,29 +207,56 @@ class FlightRecorder:
                 continue
             olab = str(origin)
             max_lag: Optional[int] = None
+            tenants: Dict[str, int] = {}
             for seq in range(lo + 1, hi + 1):
+                tenant: Optional[str] = None
+                if tenant_of is not None and cmds is not None:
+                    cmd = cmds.get((origin, seq))
+                    if cmd:
+                        tenant = tenant_of(cmd)
+                        if tenant:
+                            tenants[tenant] = tenants.get(tenant, 0) + 1
+                if extra or tenant:
+                    lbl = dict(extra, origin=olab, node=self.node_label)
+                    if tenant:
+                        lbl["tenant"] = tenant
+                else:
+                    # host-plane fast path: no per-seq dict build — the
+                    # label set (and per-op cost) predates the tier labels
+                    lbl = None
                 if births is not None:
                     born = births.get((origin, seq))
                     if born is not None:
-                        self.registry.observe(
-                            "op_propagation",
-                            max(0.0, (now_ms - born) / 1e3),
-                            origin=olab, node=self.node_label,
-                        )
+                        secs = max(0.0, (now_ms - born) / 1e3)
+                        if lbl is None:
+                            self.registry.observe(
+                                "op_propagation", secs,
+                                origin=olab, node=self.node_label,
+                            )
+                        else:
+                            self.registry.observe(
+                                "op_propagation", secs, **lbl)
                 if step is not None and self.ledger is not None:
                     bstep = self.ledger.birth_step(origin, seq)
                     if bstep is not None:
                         lag = max(0, step - bstep)
-                        self.registry.observe(
-                            "op_propagation_steps", float(lag),
-                            origin=olab, node=self.node_label,
-                        )
+                        if lbl is None:
+                            self.registry.observe(
+                                "op_propagation_steps", float(lag),
+                                origin=olab, node=self.node_label,
+                            )
+                        else:
+                            self.registry.observe(
+                                "op_propagation_steps", float(lag), **lbl)
                         max_lag = lag if max_lag is None else max(max_lag, lag)
             total += hi - lo
             if self.events is not None:
+                fields: Dict[str, object] = dict(extra)
+                if tenants:
+                    fields["tenants"] = tenants
                 self.events.emit("op_visible", trace=tid, origin=origin,
                                  seq_lo=lo + 1, seq_hi=hi, n=hi - lo,
-                                 lag_steps=max_lag)
+                                 lag_steps=max_lag, **fields)
         return total
 
 
@@ -221,4 +278,29 @@ def propagation_summary(*registries) -> Dict[str, float]:
         out[f"propagation_{unit}_count"] = merged.count
         out[f"propagation_{unit}_p50"] = round(merged.quantile(0.5), 6)
         out[f"propagation_{unit}_p99"] = round(merged.quantile(0.99), 6)
+    return out
+
+
+def propagation_by_tenant(*registries) -> Dict[str, Dict[str, float]]:
+    """Per-tenant fold of the propagation histograms: only series a
+    shard recorder labeled with a tenant participate (the host plane's
+    unlabeled series are a different tier, not tenant traffic).  Returns
+    ``{tenant: {steps_count, steps_p50, steps_p99, s_count, ...}}`` —
+    the per-tenant SLO view's propagation column (obs/fleet.py)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for name, unit in (("op_propagation_steps", "steps"),
+                       ("op_propagation", "s")):
+        folds: Dict[str, object] = {}
+        for registry in registries:
+            for labels, h in registry.histograms(name):
+                tenant = labels.get("tenant")
+                if not tenant:
+                    continue
+                cur = folds.get(tenant)
+                folds[tenant] = h if cur is None else cur.merge(h)
+        for tenant, h in folds.items():
+            d = out.setdefault(tenant, {})
+            d[f"{unit}_count"] = h.count
+            d[f"{unit}_p50"] = round(h.quantile(0.5), 6)
+            d[f"{unit}_p99"] = round(h.quantile(0.99), 6)
     return out

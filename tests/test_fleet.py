@@ -1,0 +1,267 @@
+"""Fleet SLO rollup tests (crdt_tpu.obs.fleet): the Prometheus text
+round-trip the whole tier leans on, the per-tenant/per-shard/per-slot
+summary fold, slo_breach accounting held 1:1 against shed provenance,
+the CLI, and the live ``GET /fleet`` route.
+
+The rollup's one invariant worth stating: the parse is EXACT — the
+registry's log2 buckets are the exposition's buckets, so a parsed
+histogram merges bit-identically with the one that rendered it.  Every
+other number in the fleet view (quantiles, coverage, shed ratios) is
+derived from that exactness, so the round-trip test anchors the file.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from crdt_tpu.obs import fleet
+from crdt_tpu.obs.events import EventLog
+from crdt_tpu.obs.registry import MetricsRegistry
+
+# ------------------------------------------------------- parser
+
+
+def test_parse_prometheus_round_trip():
+    reg = MetricsRegistry()
+    reg.inc("ingest_shed", 3.0, lane="tenant", node="0", tenant="t-a")
+    reg.inc("keyspace_tenant_ops", 7.0, tenant="t-a", node="0")
+    reg.set_gauge("keyspace_shard_ops", 42.0, shard="1", node="0")
+    # label escaping survives the round trip (quote, backslash, newline)
+    reg.set_gauge("keyspace_tenant_depth", 2.0,
+                  tenant='t-"q\\uo\nte"', node="0")
+    for v in (0.001, 0.004, 0.25, 3.0, 3.0):
+        reg.observe("ks_admit_latency", v, tenant="t-a", node="0")
+    for lag in (0.0, 1.0, 2.0, 2.0, 40.0):
+        reg.observe("op_propagation_steps", lag,
+                    origin="1", node="0", tenant="t-a", shard="0")
+
+    snap = fleet.parse_prometheus(reg.render_prometheus())
+
+    assert snap.counters_named("ingest_shed") == [
+        ({"lane": "tenant", "node": "0", "tenant": "t-a"}, 3.0)]
+    assert snap.counters_named("keyspace_tenant_ops")[0][1] == 7.0
+    assert snap.gauges_named("keyspace_shard_ops") == [
+        ({"shard": "1", "node": "0"}, 42.0)]
+    [(lbl, _)] = snap.gauges_named("keyspace_tenant_depth")
+    assert lbl["tenant"] == 't-"q\\uo\nte"'
+    # histograms rebuild EXACTLY: buckets, sum, count
+    [(lbl, h)] = snap.hists_named("ks_admit_latency")
+    src = reg.histogram("ks_admit_latency", tenant="t-a", node="0")
+    assert lbl == {"tenant": "t-a", "node": "0"}
+    assert h.buckets == src.buckets and h.count == src.count
+    assert h.sum == pytest.approx(src.sum)
+    assert h.quantile(0.5) == src.quantile(0.5)
+    [(lbl, h)] = snap.hists_named("op_propagation_steps")
+    src = reg.histogram("op_propagation_steps", origin="1", node="0",
+                        tenant="t-a", shard="0")
+    assert h.buckets == src.buckets and h.count == src.count
+
+
+# ------------------------------------------------------- summary fold
+
+
+def _two_member_texts(*, observed=4):
+    """Member '0' admits 4 ops for t-a (and sheds 2 for t-noisy);
+    member '1' observes ``observed`` of them propagate."""
+    r0 = MetricsRegistry()
+    r0.inc("keyspace_tenant_ops", 4.0, tenant="t-a", node="0")
+    r0.inc("ingest_shed", 2.0, lane="tenant", node="0", tenant="t-noisy")
+    r0.inc("ingest_shed_ops", 6.0, lane="tenant", node="0",
+           tenant="t-noisy")
+    r0.set_gauge("keyspace_tenant_quota", 8.0, tenant="t-noisy", node="0")
+    r0.set_gauge("keyspace_shard_ops", 3.0, shard="0", node="0")
+    r0.set_gauge("keyspace_shard_ops", 1.0, shard="1", node="0")
+    r0.set_gauge("lease_state", 1.0, slot="0", node="0")
+    r0.set_gauge("lease_fence_epoch", 3.0, slot="0", node="0")
+    for v in (0.001, 0.002, 0.004, 0.008):
+        r0.observe("ks_admit_latency", v, tenant="t-a", node="0")
+    r1 = MetricsRegistry()
+    for _ in range(observed):
+        r1.observe("op_propagation_steps", 2.0,
+                   origin="0", node="1", tenant="t-a", shard="0")
+    r1.set_gauge("lease_state", 0.0, slot="0", node="1")
+    r1.set_gauge("lease_fence_epoch", 3.0, slot="0", node="1")
+    return {"0": r0.render_prometheus(), "1": r1.render_prometheus()}
+
+
+def test_fleet_summary_tenant_shard_slot_rows():
+    report = fleet.fleet_from_texts(_two_member_texts())
+    assert report["n_members"] == 2 and report["members"] == ["0", "1"]
+
+    ta = report["tenants"]["t-a"]
+    assert ta["ops"] == 4 and ta["sheds"] == 0
+    assert ta["admit_p99_ms"] is not None
+    assert ta["prop_p99_steps"] is not None
+    # exactly-once accounting: 4 admitted x (2 members - 1) = 4 expected,
+    # 4 observed -> full coverage
+    assert ta["prop_expected"] == 4 and ta["prop_observed"] == 4
+    assert ta["prop_coverage"] == 1.0
+
+    noisy = report["tenants"]["t-noisy"]
+    assert noisy["sheds"] == 2 and noisy["shed_ops"] == 6
+    assert noisy["quota"] == 8.0
+    assert noisy["shed_ratio"] == 1.0  # 6 shed / (0 admitted + 6 shed)
+
+    assert report["shards"]["0"]["ops_total"] == 3.0
+    assert report["shard_balance"] == pytest.approx(1.5)  # 3 / mean(3,1)
+
+    slot = report["slots"]["0"]
+    assert slot["holder"] == "0" and slot["fence"] == 3
+    assert slot["expired"] == []
+
+    # default SLO: the all-shed tenant breaches shed_ratio, nothing else
+    kinds = {(b["kind"], b["tenant"]) for b in report["slo_breaches"]}
+    assert kinds == {("shed_ratio", "t-noisy")}
+
+
+def test_partial_coverage_is_reported_not_clamped():
+    report = fleet.fleet_from_texts(_two_member_texts(observed=3))
+    assert report["tenants"]["t-a"]["prop_coverage"] == 0.75
+
+
+# ------------------------------------------------------- SLO + reconcile
+
+
+def test_evaluate_slo_emits_events_and_reconciles():
+    events = EventLog(node="0")
+    report = fleet.fleet_from_texts(
+        _two_member_texts(), slo={"shed_ratio": 0.5}, events=events)
+    [breach] = [b for b in report["slo_breaches"]
+                if b["kind"] == "shed_ratio"]
+    assert breach["tenant"] == "t-noisy" and breach["n_sheds"] == 2
+    assert breach["quota"] == 8.0
+    recorded = list(events.find(event="slo_breach"))
+    assert len(recorded) == len(report["slo_breaches"])
+    assert any(e["tenant"] == "t-noisy" for e in recorded)
+
+    # the breach's n_sheds must equal the ingest_shed provenance count —
+    # same call site increments the counter and emits the event, so any
+    # drift is a lost record
+    shed_events = [{"event": "ingest_shed", "tenant": "t-noisy"}] * 2
+    rec = fleet.reconcile_sheds(report["slo_breaches"], shed_events)
+    assert rec["ok"] and rec["tenants"]["t-noisy"] == {
+        "slo": 2, "provenance": 2, "ok": True}
+    rec = fleet.reconcile_sheds(report["slo_breaches"], shed_events[:1])
+    assert not rec["ok"] and not rec["tenants"]["t-noisy"]["ok"]
+
+
+def test_lease_timeline_orders_and_filters():
+    records = [
+        {"event": "lease_renew", "slot": 0, "fence": 1, "node": "0",
+         "ts_ms": 30},
+        {"event": "pull_merge", "node": "1", "ts_ms": 5},  # not lease
+        {"event": "lease_grant", "slot": 0, "fence": 1, "node": "0",
+         "ts_ms": 10, "holder": "http://a"},
+        {"event": "cas_fenced_reject", "slot": 0, "fence": 1, "known": 2,
+         "node": "1", "ts_ms": 40, "trace": "tr-9"},
+        {"event": "lease_grant", "slot": 1, "fence": 5, "node": "1",
+         "ts_ms": 20},
+    ]
+    tl = fleet.lease_timeline(records)
+    assert [r["event"] for r in tl["0"]] == [
+        "lease_grant", "lease_renew", "cas_fenced_reject"]
+    assert tl["0"][0]["holder"] == "http://a"
+    assert tl["0"][2]["known"] == 2 and tl["0"][2]["trace"] == "tr-9"
+    assert [r["fence"] for r in tl["1"]] == [5]
+
+
+# ------------------------------------------------------- CLI
+
+
+def test_fleet_cli_files_logs_and_coverage_gate(tmp_path, capsys):
+    texts = _two_member_texts()
+    paths = []
+    for name, text in texts.items():
+        p = tmp_path / f"member{name}.prom"
+        p.write_text(text)
+        paths.append(str(p))
+    log = tmp_path / "events.jsonl"
+    with open(log, "w") as fh:
+        for _ in range(2):
+            fh.write(json.dumps({"event": "ingest_shed",
+                                 "tenant": "t-noisy", "node": "0"}) + "\n")
+        fh.write(json.dumps({"event": "lease_grant", "slot": 0,
+                             "fence": 3, "node": "0", "ts_ms": 1}) + "\n")
+    out = tmp_path / "fleet.json"
+    rc = fleet.main(paths + ["--logs", str(log), "--min-coverage", "95",
+                             "--out", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["tenants"]["t-a"]["prop_coverage"] == 1.0
+    assert report["shed_reconciliation"]["ok"]
+    assert report["lease_timelines"]["0"][0]["event"] == "lease_grant"
+    capsys.readouterr()
+
+    # coverage shortfall fails the gate (one observation lost)
+    short = tmp_path / "short.prom"
+    texts = _two_member_texts(observed=3)
+    short.write_text(texts["1"])
+    (tmp_path / "m0.prom").write_text(texts["0"])
+    rc = fleet.main([str(tmp_path / "m0.prom"), str(short),
+                     "--min-coverage", "95"])
+    assert rc == 1
+    assert "coverage" in capsys.readouterr().err
+
+
+# ------------------------------------------------------- GET /fleet
+
+
+def test_fleet_http_route_end_to_end():
+    """Two live NodeHosts with the keyspace tier: tenant writes + one
+    forced quota shed on node a, then ``GET /fleet`` on a folds BOTH
+    members' expositions, reports the tenant rows, flags the shed-ratio
+    breach, and records slo_breach in a's black box."""
+    import urllib.error
+
+    from crdt_tpu.api.net import NodeHost, RemotePeer
+    from crdt_tpu.keyspace import TENANT_HEADER
+    from crdt_tpu.utils.config import ClusterConfig
+
+    cfg = ClusterConfig(keyspace_shards=2, keyspace_capacity=64,
+                        keyspace_tenant_quota={"t-noisy": 2})
+    a = NodeHost(rid=0, peers=[], config=cfg)
+    b = NodeHost(rid=1, peers=[], config=cfg)
+    a.agent.peers = [RemotePeer(b.url)]
+    for h in (a, b):
+        threading.Thread(target=h._server.serve_forever,
+                         daemon=True).start()
+    try:
+        def post(url, body, tenant):
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(), method="POST")
+            req.add_header(TENANT_HEADER, tenant)
+            return urllib.request.urlopen(req, timeout=5)
+
+        assert post(a.url + "/data", {"k1": "v1", "k2": "v2"},
+                    "t-acme").status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(a.url + "/data", {f"k{i}": "v" for i in range(3)},
+                 "t-noisy")
+        assert ei.value.code == 429
+
+        body = urllib.request.urlopen(
+            a.url + "/fleet?shed_ratio=0.001", timeout=5).read()
+        report = json.loads(body)
+        assert report["n_members"] == 2
+        assert report["tenants"]["t-acme"]["ops"] == 2
+        noisy = report["tenants"]["t-noisy"]
+        assert noisy["sheds"] >= 1 and noisy["quota"] == 2.0
+        assert any(b["kind"] == "shed_ratio" and b["tenant"] == "t-noisy"
+                   for b in report["slo_breaches"])
+        # shard balance section exists once the tier has traffic
+        assert report["shards"] and report["shard_balance"] is not None
+        # the rollup recorded its threshold crossings as events
+        assert any(e["tenant"] == "t-noisy"
+                   for e in a.node.events.find(event="slo_breach"))
+        # bad query param is a 400, not a crash
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(a.url + "/fleet?shed_ratio=nope",
+                                   timeout=5)
+        assert ei.value.code == 400
+    finally:
+        for h in (a, b):
+            h._server.shutdown()
+            h._server.server_close()

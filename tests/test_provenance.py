@@ -428,3 +428,119 @@ def test_obs_main_dispatches_assemble(tmp_path, capsys):
     assert obs_main(["assemble", p0, p1, "--out", str(out)]) == 0
     assert json.loads(out.read_text())["traceEvents"]
     assert obs_main(["no-such-cmd"]) == 2
+
+
+# ---- keyspace tier: tenant/shard-labeled propagation (ISSUE 16) ----
+
+
+def test_keyspace_tenant_labeled_exactly_once_dup_reorder():
+    """Tenant writes through the front door propagate to a peer keyspace
+    with {tenant, shard}-labeled exactly-once derivation: duplicate and
+    stale-reordered payload deliveries add ZERO observations, and the
+    op_visible events carry the shard label plus the per-tenant count."""
+    from crdt_tpu.keyspace import KeyspaceFrontDoor, ShardedKeyspace
+
+    step = {"n": 0}
+    writer = ShardedKeyspace(rid=0, n_shards=2, capacity=64,
+                             metrics=Metrics(registry=MetricsRegistry()))
+    puller = ShardedKeyspace(rid=1, n_shards=2, capacity=64,
+                             metrics=Metrics(registry=MetricsRegistry()))
+    # per-shard FLEET-WIDE ledgers: shard i shares one (rid, seq) space
+    # on every member, disjoint from its siblings'
+    ledgers = [BirthLedger(), BirthLedger()]
+    for ks in (writer, puller):
+        for i, shard in enumerate(ks.shards):
+            shard.recorder.install(ledger=ledgers[i],
+                                   step_clock=lambda: step["n"])
+    door = KeyspaceFrontDoor(writer, max_batch=1)
+    for i in range(3):
+        step["n"] = i
+        assert door.admit_kv("t-acme", f"k{i}", str(i), timeout=5.0)
+    old = [writer.gossip_payload(s, None) for s in range(2)]
+    step["n"] = 4
+    assert door.admit_kv("t-acme", "k3", "3", timeout=5.0)
+    assert door.admit_kv("t-bolt", "kb", "vb", timeout=5.0)
+    new = [writer.gossip_payload(s, None) for s in range(2)]
+
+    def tenant_counts():
+        out = {}
+        reg = puller.shards[0].metrics.registry  # shared across shards
+        for labels, h in reg.histograms("op_propagation_steps"):
+            t = labels.get("tenant")
+            if t:
+                assert labels["shard"] in ("0", "1")
+                assert labels["origin"] == "0" and labels["node"] == "1"
+                out[t] = out.get(t, 0) + h.count
+        return out
+
+    step["n"] = 6
+    assert sum(puller.receive(s, new[s]) for s in range(2)) == 5
+    assert tenant_counts() == {"t-acme": 4, "t-bolt": 1}
+    # byte-identical duplicates: vv unchanged -> zero new observations
+    assert sum(puller.receive(s, new[s]) for s in range(2)) == 0
+    # stale payloads after newer ones (reorder): still zero
+    assert sum(puller.receive(s, old[s]) for s in range(2)) == 0
+    assert tenant_counts() == {"t-acme": 4, "t-bolt": 1}
+    # events agree: shard-labeled, each seq exactly once per shard, and
+    # the tenants rollup matches the histogram counts
+    seen = {}
+    tenants = {}
+    for shard in puller.shards:  # each shard keeps its own black box here
+        for ev in shard.events.find(event="op_visible"):
+            key = (ev["shard"], ev["origin"])
+            seen.setdefault(key, []).extend(
+                range(ev["seq_lo"], ev["seq_hi"] + 1))
+            for t, n in (ev.get("tenants") or {}).items():
+                tenants[t] = tenants.get(t, 0) + n
+    for key, seqs in seen.items():
+        assert sorted(seqs) == sorted(set(seqs)), key
+    assert tenants == {"t-acme": 4, "t-bolt": 1}
+
+
+def test_assembler_lease_track_round_trip(tmp_path):
+    """Lease events assemble into the per-slot track: fence epochs as
+    counter samples, lease instants on the slot track, and a handoff
+    (grant by a DIFFERENT node) drawn as a flow arrow between the two
+    holders' node tracks."""
+    t = 1_000_000
+    n0 = [
+        {"v": 2, "ts_ms": t, "node": "0", "event": "boot", "step": 0},
+        {"v": 2, "ts_ms": t + 5, "node": "0", "event": "lease_grant",
+         "slot": 0, "fence": 1, "holder": "http://a", "trace": "tr-l1",
+         "step": 1},
+        {"v": 2, "ts_ms": t + 8, "node": "0", "event": "lease_renew",
+         "slot": 0, "fence": 1, "holder": "http://a", "step": 2},
+        {"v": 2, "ts_ms": t + 20, "node": "0", "event": "lease_expire",
+         "slot": 0, "fence": 1, "step": 4},
+    ]
+    n1 = [
+        {"v": 2, "ts_ms": t + 1, "node": "1", "event": "boot", "step": 0},
+        {"v": 2, "ts_ms": t + 30, "node": "1", "event": "lease_grant",
+         "slot": 0, "fence": 2, "holder": "http://b", "trace": "tr-l2",
+         "step": 6},
+        {"v": 2, "ts_ms": t + 35, "node": "1", "event":
+         "cas_fenced_reject", "slot": 0, "fence": 1, "known": 2,
+         "trace": "tr-z", "step": 7},
+    ]
+    p0 = _write_jsonl(tmp_path / "node0.jsonl", n0)
+    p1 = _write_jsonl(tmp_path / "node1.jsonl", n1)
+    records = assemble.load_node_logs([p0, p1])
+    trace = assemble.assemble_trace(records)
+    evs = trace["traceEvents"]
+    # the slot track exists and is named
+    meta = {e.get("args", {}).get("name") for e in evs if e["ph"] == "M"}
+    assert "lease slot 0" in meta
+    # fence epochs render as counter samples, monotone 1 -> 2
+    fences = [e["args"]["fence"] for e in evs
+              if e["ph"] == "C" and e["name"] == "lease fence s0"]
+    assert fences == sorted(fences) and fences[-1] == 2
+    # every lease event is an instant on the slot track
+    kinds = [e["name"] for e in evs
+             if e["ph"] == "i" and e.get("args", {}).get("slot") == 0]
+    assert {"lease_grant", "lease_renew", "lease_expire",
+            "cas_fenced_reject"} <= set(kinds)
+    # the handoff (node 0's lease -> node 1's grant) is a flow arrow
+    flows = [e for e in evs if e["ph"] in ("s", "f")
+             and e["name"] == "lease_handoff"]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert len({e["id"] for e in flows}) == 1
