@@ -180,12 +180,40 @@ def bench_gc_footprint(rounds: int, ops_per_round: int, gc_every: int,
     reclaimed = sum(
         int(n.metrics.registry.counter_value("gc_reclaimed_ops"))
         for n in gc_on)
+
+    # eager _by_writer pruning at frontier ADOPTION time: a passive
+    # node that never runs compact() itself must shed its
+    # below-frontier delta-index slices the moment a peer's gossiped
+    # frontier (which piggybacks on every payload from a compacted
+    # node) covers ops it already holds — footprint falls via gossip
+    # alone, no local collection pass
+    from crdt_tpu.api.node import ReplicaNode
+
+    passive = ReplicaNode(rid=99, capacity=gc_off[0].log.capacity)
+    for n in gc_off:  # the full raw stream: indexes fully populated
+        passive.receive(n.gossip_payload())
+    idx_before = sum(len(l) for l in passive._by_writer.values())
+    assert idx_before > 0 and not passive._frontier
+    passive.receive(gc_on[0].gossip_payload())
+    f = dict(passive._frontier)
+    assert f, "compacted peer's payload carried no frontier piggyback"
+    idx_after = sum(len(l) for l in passive._by_writer.values())
+    assert idx_after < idx_before, (
+        f"frontier adoption left the _by_writer index at {idx_after} "
+        f"rows (was {idx_before}): eager pruning broken")
+    for w, lst in passive._by_writer.items():
+        assert all(e[0][2] > f.get(w, -1) for e in lst), (
+            f"writer {w} still indexes ops at or below the adopted "
+            "stable frontier")
+
     return [{
         "phase": "gc-footprint", "rounds": rounds,
         "ops_per_round": ops_per_round, "gc_every": gc_every,
         "raw_rows_gc_on": raw_on, "raw_rows_gc_off": raw_off,
         "payload_bytes_gc_on": bytes_on, "payload_bytes_gc_off": bytes_off,
         "reclaimed_ops": reclaimed,
+        "passive_by_writer_rows_before": idx_before,
+        "passive_by_writer_rows_after": idx_after,
         "bit_equal": True,
     }]
 

@@ -22,7 +22,7 @@ producers — and each admitted group drains as ONE dispatch into ONE
 shard.  A shard-oblivious client still converges identically; it just
 pays splits at the door instead of at the producer.
 
-Two phases:
+Three phases:
 
 * **parity** — one multi-tenant stream through an S=4 keyspace door:
   per-tenant views must equal the client-side fold exactly, dispatch
@@ -35,6 +35,15 @@ Two phases:
   dispatches per arm; rep 0 of each arm is an uncounted warm-up that
   absorbs jit compilation for that arm's K/S shapes.  The gate
   (--assert-scaling) requires wps_S >= eff * S * wps_1 for S=4.
+* **mesh** (``--mesh``) — the anti-entropy A/B: identical per-shard
+  delta-gossip rounds folded through the device-mesh plane
+  (parallel.meshplane: ONE fused dispatch converges all S shards) vs
+  the per-shard host path (S dispatches per round).  Per-shard vv
+  parity is asserted after EVERY round inside the timing loop, raw
+  OpLog columns are compared bit-for-bit at the end of each rep, and
+  both arms' dispatch counts are pinned (R for mesh, R*S for host) —
+  the summary's ``dispatch_amplification`` (= S) is what the baseline
+  gate ratchets.
 
 Methodology (house rules, benches/bench_baseline.py): medians over reps,
 JSON rows on stdout.
@@ -43,6 +52,7 @@ Usage:
   python benches/bench_keyspace.py                        # default shape
   python benches/bench_keyspace.py --tiny                 # CI smoke
   python benches/bench_keyspace.py --assert-scaling 0.75  # gate 1->4
+  python benches/bench_keyspace.py --tiny --mesh          # + mesh A/B
 """
 from __future__ import annotations
 
@@ -159,6 +169,128 @@ def _check_parity(stream, total_capacity: int, batch: int) -> int:
     return n_groups
 
 
+# ---- mesh phase: device-mesh fold vs S host dispatches ----
+
+def _mesh_rounds(n_shards: int, rounds: int, ops_per_shard: int,
+                 capacity: int):
+    """R rounds x S per-shard delta-gossip payloads, built OUTSIDE the
+    timed region from writer nodes on one shared ManualClock (same
+    epoch as the receiver twins, so the folded logs are bit-comparable).
+    Every shard gets ops_per_shard fresh ops per round, so the dispatch
+    pins are exact: R*S host folds vs R fused steps."""
+    from crdt_tpu.api.node import ReplicaNode
+    from crdt_tpu.keyspace import ShardedKeyspace, qualify
+    from crdt_tpu.utils.clock import ManualClock
+
+    clock = ManualClock()
+    probe = ShardedKeyspace(rid=0, n_shards=n_shards, capacity=capacity)
+    need = rounds * ops_per_shard
+    pools = {s: [] for s in range(n_shards)}
+    i = 0
+    while any(len(p) < need for p in pools.values()):
+        key = f"u{i:06d}"
+        s = probe.shard_of("bench", key)
+        if len(pools[s]) < need:
+            pools[s].append(key)
+        i += 1
+    writers = [ReplicaNode(rid=9, capacity=capacity, clock=clock)
+               for _ in range(n_shards)]
+    out = []
+    since = [{} for _ in range(n_shards)]
+    for r in range(rounds):
+        payloads = []
+        for s in range(n_shards):
+            for j in range(ops_per_shard):
+                key = pools[s][r * ops_per_shard + j]
+                writers[s].add_commands([{qualify("bench", key): f"v{r}"}])
+                clock.advance(1)
+            payloads.append(writers[s].gossip_payload(since=since[s]))
+            since[s] = writers[s].version_vector()
+        out.append(payloads)
+    return out, clock
+
+
+def _run_mesh_rep(rounds, n_shards: int, capacity: int, clock):
+    """One rep of the A/B: fresh twins, every round folded through both
+    paths, per-shard vv parity asserted INSIDE the timing loop and raw
+    OpLog bit-parity at the end.  Returns (host wall, mesh wall,
+    engine)."""
+    import numpy as np
+
+    from crdt_tpu.keyspace import ShardedKeyspace
+    from crdt_tpu.models import oplog
+
+    host = ShardedKeyspace(rid=0, n_shards=n_shards, capacity=capacity,
+                           clock=clock, mesh="off")
+    mesh = ShardedKeyspace(rid=0, n_shards=n_shards, capacity=capacity,
+                           clock=clock, mesh="on")
+    wall_h = wall_m = 0.0
+    for payloads in rounds:
+        t0 = time.perf_counter()
+        for i, p in enumerate(payloads):
+            host.receive(i, p)
+        wall_h += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        mesh.receive_all(payloads)
+        wall_m += time.perf_counter() - t0
+        for i in range(n_shards):  # parity, every round, in the loop
+            assert (mesh.version_vector(i) == host.version_vector(i)), (
+                f"shard {i} vv diverged mesh-vs-host mid-run")
+    for i, (h, m) in enumerate(zip(host.shards, mesh.shards)):
+        assert m.get_state() == h.get_state(), f"shard {i} state diverged"
+        n = int(oplog.size(h.log))
+        assert int(oplog.size(m.log)) == n
+        for col in ("ts", "rid", "seq", "key", "val", "payload", "is_num"):
+            assert np.array_equal(np.asarray(getattr(h.log, col))[:n],
+                                  np.asarray(getattr(m.log, col))[:n]), (
+                f"shard {i} column {col} not bit-identical")
+    n_rounds = len(rounds)
+    assert _dispatches(host) == n_rounds * n_shards, (
+        f"host path: {_dispatches(host)} dispatches for "
+        f"{n_rounds} rounds x {n_shards} shards")
+    assert _dispatches(mesh) == n_rounds, (
+        f"mesh path: {_dispatches(mesh)} dispatches for {n_rounds} "
+        "rounds — the one-fused-step-per-round contract broke")
+    return wall_h, wall_m, mesh.mesh_engine
+
+
+def _check_mesh(rounds_n: int, ops_per_shard: int, capacity: int,
+                reps: int, rows: list):
+    n_shards = 4
+    rounds, clock = _mesh_rounds(n_shards, rounds_n, ops_per_shard,
+                                 capacity)
+    walls_h, walls_m = [], []
+    engine = None
+    for rep in range(reps + 1):  # rep 0 = uncounted warm-up
+        wall_h, wall_m, engine = _run_mesh_rep(rounds, n_shards,
+                                               capacity, clock)
+        if rep == 0:
+            continue
+        walls_h.append(wall_h)
+        walls_m.append(wall_m)
+        rows.append({"phase": "mesh", "rep": rep, "engine": engine,
+                     "wall_s_host": round(wall_h, 4),
+                     "wall_s_mesh": round(wall_m, 4)})
+    med_h = statistics.median(walls_h)
+    med_m = statistics.median(walls_m)
+    rows.append({
+        "bench": "keyspace_mesh", "engine": engine,
+        "rounds": rounds_n, "n_shards": n_shards,
+        "ops": rounds_n * ops_per_shard * n_shards,
+        "wall_s_host_median_s": round(med_h, 4),
+        "wall_s_mesh_median_s": round(med_m, 4),
+        "mesh_speedup": round(med_h / med_m, 2),
+        "dispatches_host": rounds_n * n_shards,
+        "dispatches_mesh": rounds_n,
+        # host dispatches per fused step — the S-to-1 collapse the
+        # baseline gate pins (exact by the asserts above, so the gate is
+        # machine-insensitive; wall speedup is reported, not gated)
+        "dispatch_amplification": round(
+            (rounds_n * n_shards) / rounds_n, 2),
+        "parity_exact": True,
+    })
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--n-ops", type=int, default=8_192,
@@ -174,6 +306,13 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--tiny", action="store_true",
                     help="CI smoke: 2K-op arms over 64K total capacity")
+    ap.add_argument("--mesh", action="store_true",
+                    help="run the device-mesh anti-entropy A/B phase "
+                         "(fused meshplane fold vs S host dispatches)")
+    ap.add_argument("--mesh-rounds", type=int, default=24,
+                    help="gossip rounds per mesh-phase rep")
+    ap.add_argument("--mesh-ops", type=int, default=32,
+                    help="fresh ops per shard per mesh-phase round")
     ap.add_argument("--assert-scaling", type=float, nargs="?",
                     const=0.75, default=None, metavar="EFF",
                     help="exit nonzero unless the 4-shard arm reaches "
@@ -186,6 +325,7 @@ def main() -> int:
         # ~16K/shard drowns it in the fixed dispatch floor
         args.n_ops, args.capacity, args.batch = 2_048, 65_536, 64
         args.n_parity, args.reps = 512, 2
+        args.mesh_rounds, args.mesh_ops = 12, 16
 
     rows = []
 
@@ -223,6 +363,17 @@ def main() -> int:
                          "dispatches": len(groups),
                          "shard_capacity": args.capacity // n_shards})
         walls[n_shards] = statistics.median(arm_walls)
+
+    # ---- phase 3: device-mesh anti-entropy A/B (opt-in) ----
+    if args.mesh:
+        # per-shard capacity sized so a rep never grows mid-round (growth
+        # is lossless but changes compiled shapes; the warm-up rep then
+        # wouldn't cover the measured ones)
+        mesh_cap = 1024
+        while mesh_cap < 2 * args.mesh_rounds * args.mesh_ops:
+            mesh_cap *= 2
+        _check_mesh(args.mesh_rounds, args.mesh_ops, mesh_cap,
+                    args.reps, rows)
 
     wps = {s: args.n_ops / walls[s] for s in ARMS}
     eff = {s: wps[s] / (s * wps[1]) for s in ARMS}

@@ -906,6 +906,8 @@ class NetworkAgent:
         # round events below — shard gossip shows up in assembled traces
         # exactly like the host plane's pulls (ISSUE 16 satellite)
         tid = mint_trace_id(self.node.rid)
+        if ks.mesh_active:
+            return self._ks_pull_mesh(ks, peer, tid)
         fresh_total = 0
         for i, shard in enumerate(ks.shards):
             since = shard.version_vector() \
@@ -931,6 +933,55 @@ class NetworkAgent:
             self.node.events.emit(
                 "ks_pull_merge" if fresh else "ks_pull_noop",
                 trace=tid, peer=peer.url, shard=i, fresh=fresh)
+            try:
+                vv = {int(r): int(s)
+                      for r, s in (body.get("vv") or {}).items()}
+                frontier = {int(r): int(s)
+                            for r, s in (body.get("frontier") or {}).items()}
+            except (ValueError, TypeError):
+                continue  # summary malformed: merge stood, tracker skips
+            self.ks_trackers[i].note(peer.url, vv, frontier)
+        self.metrics.inc("net_ks_pulls")
+        if fresh_total:
+            self.metrics.inc("net_ks_fresh", fresh_total)
+        return fresh_total
+
+    def _ks_pull_mesh(self, ks, peer: RemotePeer, tid: str) -> int:
+        """The fused pull round: fetch every shard's delta first (the S
+        HTTP GETs are unchanged), then fold ALL shards in ONE device-mesh
+        step (`ShardedKeyspace.receive_all` -> `MeshPlane.converge`).
+        Same quarantine semantics as the host loop — a corrupt shard
+        payload isolates that shard's lane inside the fused step while
+        the siblings still fold."""
+        payloads: List[Optional[Dict[str, Any]]] = [None] * ks.n_shards
+        bodies: List[Optional[dict]] = [None] * ks.n_shards
+        for i, shard in enumerate(ks.shards):
+            since = shard.version_vector() \
+                if self.config.delta_gossip else None
+            body = peer.ks_gossip(i, since, trace=tid)
+            if body is None:
+                self.metrics.inc("net_ks_pull_skips")
+                self.node.events.emit("ks_pull_skip", trace=tid,
+                                      peer=peer.url, shard=i)
+                continue
+            bodies[i] = body
+            payloads[i] = body.get("payload")
+        with span("crdt.ks_pull_mesh", tid):
+            results = ks.receive_all(payloads, quarantine=True)
+        fresh_total = 0
+        for i, (body, res) in enumerate(zip(bodies, results)):
+            if body is None:
+                continue
+            if isinstance(res, str):  # quarantined lane: siblings folded
+                self.metrics.inc("net_ks_quarantined")
+                self.node.events.emit(
+                    "payload_quarantine", surface="ks_gossip",
+                    trace=tid, peer=peer.url, shard=i, error=res)
+                continue
+            fresh_total += res
+            self.node.events.emit(
+                "ks_pull_merge" if res else "ks_pull_noop",
+                trace=tid, peer=peer.url, shard=i, fresh=res)
             try:
                 vv = {int(r): int(s)
                       for r, s in (body.get("vv") or {}).items()}

@@ -248,6 +248,109 @@ def fused_pull_round(node: "ReplicaNode", fetched, metrics, delta: bool,
         return True
 
 
+class PendingMerge:
+    """One plane's decoded + accepted (but NOT yet merged) ingest batch.
+
+    Produced by :meth:`ReplicaNode.merge_begin` /
+    :meth:`ReplicaNode.add_commands_begin` with the node lock HELD — it
+    stays held until :meth:`commit` / :meth:`commit_inline` /
+    :meth:`abort` — so the device-mesh plane
+    (crdt_tpu.parallel.meshplane) can fold MANY planes' batches in one
+    fused dispatch while each plane's host bookkeeping (command map,
+    delta indexes, vv) lands exactly where the inline path puts it.
+    Commit rebinds the merged log and finishes the metrics/recorder
+    accounting the inline path does after its own dispatch.
+    """
+
+    __slots__ = ("node", "ops", "fresh", "adopted", "rows", "births",
+                 "vv_before", "recording", "done")
+
+    def __init__(self, node: "ReplicaNode"):
+        self.node = node
+        self.ops: Optional[Dict[str, np.ndarray]] = None
+        self.fresh = 0
+        self.adopted = 0
+        # decoded wire rows (recorder tenant attribution on commit)
+        self.rows: List[Tuple[int, int, int, Dict[str, str]]] = []
+        # locally-minted (seq, abs_ts) birth stamps (add_commands_begin)
+        self.births: List[Tuple[int, int]] = []
+        self.vv_before: Optional[Dict[int, int]] = None
+        self.recording = False
+        self.done = False
+
+    def rows_held(self) -> int:
+        """Live log rows of the plane (caller of the fused step sizes the
+        uniform lane capacity from this; the lock is held so it's stable)."""
+        n = self.node._log_rows
+        if n is None:
+            n = int(oplog.size(self.node.log))
+            self.node._log_rows = n
+        return n
+
+    def commit(self, merged_log, n_unique: int) -> int:
+        """Finish the deferred merge with the FUSED step's output lane:
+        rebind the log, finish accounting, release the node lock.
+        ``n_unique`` must already be a host int (the mesh plane syncs the
+        whole lane-count vector in one transfer)."""
+        node = self.node
+        try:
+            if self.fresh:
+                assert n_unique <= merged_log.ts.shape[-1], (
+                    f"fused union {n_unique} rows overflowed lane capacity "
+                    f"{merged_log.ts.shape[-1]}")
+                node.log = merged_log
+                node._log_rows = int(n_unique)
+                node.metrics.inc("ops_ingested", self.fresh)
+                node._count_lane_fold()
+            self._finish_recording()
+        finally:
+            self.done = True
+            node._lock.release()
+        return self.fresh + self.adopted
+
+    def commit_inline(self) -> int:
+        """Fallback: run THIS lane's merge as the inline host dispatch
+        (one jitted merge, exactly `_merge_batch`) and finish accounting.
+        Used when the fused step cannot run (engine failure) so a lane is
+        never left with host indexes ahead of its log."""
+        node = self.node
+        try:
+            if self.fresh:
+                node._merge_batch(self.ops, self.fresh)
+            self._finish_recording()
+        finally:
+            self.done = True
+            node._lock.release()
+        return self.fresh + self.adopted
+
+    def abort(self) -> None:
+        """Release the node lock WITHOUT merging.  Only for process-fatal
+        unwind: if fresh ops were accepted, the host indexes are ahead of
+        the log until a later merge lands them (prefer commit_inline)."""
+        self.done = True
+        self.node._lock.release()
+
+    def _finish_recording(self) -> None:
+        node = self.node
+        if self.births and node.recorder.enabled:
+            node.recorder.note_births(self.births)
+        if not self.recording:
+            return
+        vv_after = node._version_vector_locked()
+        if vv_after == self.vv_before:
+            return
+        epoch = node.clock.epoch_ms
+        cmds = None
+        if node.recorder.tenant_of is not None:
+            cmds = {(rid, seq): cmd for _, rid, seq, cmd in self.rows}
+        node.recorder.note_visible(
+            self.vv_before, vv_after,
+            births={(rid, seq): ts + epoch
+                    for ts, rid, seq, _ in self.rows},
+            cmds=cmds,
+        )
+
+
 class ReplicaNode:
     def __init__(
         self,
@@ -311,6 +414,14 @@ class ReplicaNode:
         # (post-compaction): lets the batched write path skip a jitted
         # oplog.size dispatch + host sync per drain
         self._log_rows: Optional[int] = 0
+        # extra metric labels for this plane's merge accounting (the
+        # sharded keyspace binds {"shard": i}).  The label-free counters
+        # keep their one-tick-per-DEVICE-dispatch meaning; when labels
+        # are bound, merge_dispatches{shard=..} / union_path{shard=..}
+        # additionally tick once per FOLDED LANE — so per-shard
+        # attribution survives the mesh plane's fusion, which collapses
+        # S lane folds into one device dispatch (parallel.meshplane).
+        self._metric_labels: Dict[str, str] = {}
         # write-behind appends for the native wire cache: the batched
         # ingest drain queues (ts_abs, rid, seq, kids, vids) rows here and
         # every _wire reader drains via _flush_wire_locked — the per-op
@@ -442,7 +553,7 @@ class ReplicaNode:
             return None
         with self._lock:
             if self._frontier:
-                kv = compactlog.rebuild(self._device_clog())
+                kv = compactlog.rebuild(self._device_clog_locked())
             else:
                 kv = oplog.rebuild(self.log, n_keys=self._n_keys())
             return oplog.materialize(kv, self.keys, self.values)
@@ -560,13 +671,19 @@ class ReplicaNode:
                 start = since.get(w, -1) + 1 - lst[0][0][2]
                 for k, v in lst[max(start, 0):]:
                     payload[_wire_key(k[0] + epoch, k[1], k[2])] = dict(v)
-        if self._needs_sections_locked(since):
+        if self._frontier:
+            # the frontier piggybacks on EVERY payload (eager pruning: a
+            # caught-up requester folds + prunes at adoption time from its
+            # own raw ops — _adopt_frontier_locked's local-fold branch);
+            # the summary sections ride along only when the requester is
+            # behind the fold and needs them to reconstruct state
             payload[FRONTIER_KEY] = {
                 str(r): s for r, s in self._frontier.items()
             }
-            payload[SUMMARY_KEY] = {
-                k: dict(e) for k, e in self._summary.items()
-            }
+            if self._needs_sections_locked(since):
+                payload[SUMMARY_KEY] = {
+                    k: dict(e) for k, e in self._summary.items()
+                }
         return payload
 
     def gossip_payload_json(
@@ -581,10 +698,11 @@ class ReplicaNode:
         if not self.alive:
             return None
         with self._lock:
-            if self._wire is not None and not self._needs_sections_locked(since) \
+            if self._wire is not None and not self._frontier \
                     and not (self.go_compat_gossip and since is None):
-                # (the C++ emitter writes native ts:rid:seq keys; go-compat
-                # full dumps take the Python path)
+                # (the C++ emitter writes native ts:rid:seq keys and no
+                # frontier/summary sections, so any folded node serves via
+                # the Python path; go-compat full dumps likewise)
                 self._flush_wire_locked()
                 return self._wire.payload_json(since)
             payload = self._payload_locked(since)
@@ -681,7 +799,7 @@ class ReplicaNode:
         :func:`fused_pull_round`).
 
         Bit-exact against merging the payloads one ``receive`` at a time in
-        any order: the op union is ACI (identical idents dedup in _accept,
+        any order: the op union is ACI (identical idents dedup in _accept_locked,
         the ingest batch is canonically re-sorted by from_ops/merge), and
         compaction frontiers on a correctly-deployed fleet form a chain, so
         adopting them in payload order lands on the same maximal fold.  The
@@ -728,6 +846,91 @@ class ReplicaNode:
             )
         return fresh + adopted
 
+    # ---- deferred merge (the device-mesh plane's entry points) ----
+
+    def merge_begin(self, payloads: List[Dict[str, Any]]) -> PendingMerge:
+        """Deferred-merge half of :meth:`receive_many`: decode + adopt
+        frontiers + accept + pack ``payloads`` exactly like the inline
+        path, but STOP before the device dispatch and return the packed
+        batch with the node lock HELD.  The mesh plane
+        (crdt_tpu.parallel.meshplane.MeshPlane) folds many planes'
+        pending batches in ONE fused dispatch, then calls
+        :meth:`PendingMerge.commit` (or ``commit_inline`` on engine
+        failure) on each.  Never call from a thread already holding this
+        node's lock; an empty ``payloads`` still returns a (zero-fresh)
+        pending so the caller's lane layout stays static."""
+        decoded = [self._decode_payload(p) for p in payloads if p]
+        pending = PendingMerge(self)
+        self._lock.acquire()
+        try:
+            pending.recording = self.recorder.enabled
+            if pending.recording:
+                pending.vv_before = self._version_vector_locked()
+            if self.alive and decoded:
+                rows_all: List[Tuple[int, int, int, Dict[str, str]]] = []
+                for remote_frontier, remote_summary, rows in decoded:
+                    if remote_frontier:
+                        pending.adopted += self._adopt_frontier_locked(
+                            remote_frontier, remote_summary
+                        )
+                    rows_all.extend(rows)
+                pending.rows = rows_all
+                pending.ops, pending.fresh = self._pack_accepted_locked(
+                    self._accept_locked(rows_all))
+        except BaseException:
+            self._lock.release()
+            raise
+        return pending
+
+    def add_commands_begin(
+        self,
+        cmds: List[Dict[str, str]],
+        tss: Optional[List[Optional[int]]] = None,
+    ) -> Tuple[Optional[List[Tuple[int, int]]], PendingMerge]:
+        """Deferred-merge half of :meth:`add_commands` (the fused keyspace
+        drain): mint seqs and do every piece of host bookkeeping, but
+        leave the device merge to the mesh plane.  Returns ``(idents,
+        pending)`` with the node lock HELD inside ``pending``; idents is
+        None when the node is down (the pending is then zero-fresh and
+        must still be committed/aborted to release the lock)."""
+        pending = PendingMerge(self)
+        self._lock.acquire()
+        try:
+            if not self.alive:
+                return None, pending
+            if not cmds:
+                return [], pending
+            n = len(cmds)
+            if tss is None:
+                now = self.clock.now_ms()
+                tss = [now] * n
+            else:
+                if len(tss) != n:
+                    raise ValueError(
+                        f"{len(tss)} timestamps for {n} commands")
+                if None in tss:
+                    now = self.clock.now_ms()
+                    tss = [now if t is None else t for t in tss]
+            if not (0 <= min(tss) and max(tss) < INT32_MAX):
+                i, ts = next((i, t) for i, t in enumerate(tss)
+                             if not (0 <= t < INT32_MAX))
+                raise ValueError(
+                    f"batch op {i}: timestamp {ts} outside the storable "
+                    f"int32 window [0, {INT32_MAX}) (ts == {INT32_MAX} "
+                    "is the SENTINEL padding encoding)"
+                )
+            seq0 = self._seq.reserve(n)
+            pending.ops, pending.fresh = self._pack_local_batch(
+                cmds, tss, seq0)
+            epoch = self.clock.epoch_ms
+            pending.births = [(seq0 + i, t + epoch)
+                              for i, t in enumerate(tss)]
+            rid = self.rid
+            return [(rid, seq0 + i) for i in range(n)], pending
+        except BaseException:
+            self._lock.release()
+            raise
+
     # ---- health / fault injection ----
 
     def ping(self) -> bool:
@@ -768,25 +971,34 @@ class ReplicaNode:
             }
             if not target:
                 return
-            w = self._n_writers()
             merged = dict(self._frontier)
             merged.update(target)
             with span("crdt.compact") as tid:
-                folded = compactlog.compact(
-                    self._device_clog(n_writers=w),
-                    self._frontier_array(merged, w),
-                )
-                self.log = folded.tail
-                self._log_rows = None
-                self._frontier = merged
-                self._summary = self._decode_summary(folded.summary)
-                self._summary_cache = (
-                    folded.summary, folded.summary.num.shape[-1]
-                )
-                self._prune_commands_locked()
+                self._compact_to_locked(merged)
                 self.metrics.inc("compactions")
                 self.events.emit("compact", trace=tid,
                                  frontier={str(r): s for r, s in merged.items()})
+
+    def _compact_to_locked(self, merged: Dict[int, int]) -> None:
+        """On-device fold to ``merged`` + host pruning (caller holds the
+        lock and has already clamped ``merged`` to this node's vv and
+        checked it advances the current frontier).  Shared by explicit
+        :meth:`compact` and the adoption-time local fold in
+        :meth:`_adopt_frontier_locked` — the caller owns the counter/event
+        so "compactions" keeps meaning explicit folds only."""
+        w = self._n_writers()
+        folded = compactlog.compact(
+            self._device_clog_locked(n_writers=w),
+            self._frontier_array(merged, w),
+        )
+        self.log = folded.tail
+        self._log_rows = None
+        self._frontier = merged
+        self._summary = self._decode_summary(folded.summary)
+        self._summary_cache = (
+            folded.summary, folded.summary.num.shape[-1]
+        )
+        self._prune_commands_locked()
 
     def _adopt_frontier_locked(
         self, remote_frontier: Dict[int, int], remote_summary: Dict[str, Any]
@@ -813,6 +1025,24 @@ class ReplicaNode:
                 f"remote {remote_frontier}): frontiers must advance through "
                 "swarm-stable barriers (chain rule)"
             )
+        if all(s <= self._vv.get(r, -1) for r, s in remote_frontier.items()):
+            # Our raw ops already cover the remote fold, so fold LOCALLY
+            # instead of adopting the wire summary: a deterministic fold
+            # over identical per-writer prefixes is bit-identical to the
+            # peer's.  This is what lets the frontier piggyback on EVERY
+            # payload without shipping summary sections — a caught-up node
+            # drops its _commands/_by_writer slices below the stable
+            # frontier at adoption time (eager pruning) instead of holding
+            # them until its own compact() call.
+            merged = dict(self._frontier)
+            merged.update(remote_frontier)
+            self._compact_to_locked(merged)
+            self.metrics.inc("frontier_adoptions")
+            self.events.emit(
+                "frontier_adopt", trace=current_trace(),
+                frontier={str(r): s for r, s in self._frontier.items()},
+            )
+            return 1
         # A non-trivial frontier always folds >=1 op, and every folded op
         # contributes a key — an empty/missing summary can only mean a
         # truncated or corrupted payload.  Adopting it would silently destroy
@@ -909,7 +1139,7 @@ class ReplicaNode:
                 arr[r] = s
         return jnp.asarray(arr)
 
-    def _device_clog(self, n_writers: Optional[int] = None) -> compactlog.CompactedLog:
+    def _device_clog_locked(self, n_writers: Optional[int] = None) -> compactlog.CompactedLog:
         """The device view of this node's full state: host summary + frontier
         encoded as arrays over the current interned key space, tail = log."""
         import jax.numpy as jnp
@@ -986,7 +1216,7 @@ class ReplicaNode:
 
     # ---- internals ----
 
-    def _accept(self, rows) -> List[Tuple[int, int, int, Dict[str, str]]]:
+    def _accept_locked(self, rows) -> List[Tuple[int, int, int, Dict[str, str]]]:
         """Filter duplicate / already-folded rows, record the survivors in
         the command map + delta indexes, and return them.  Rows are taken in
         (rid, seq) order so each writer's index list stays seq-ascending
@@ -1017,49 +1247,68 @@ class ReplicaNode:
             accepted.append((ts, rid, seq, stored))
         return accepted
 
-    def _ingest(self, rows: List[Tuple[int, int, int, Dict[str, str]]]) -> int:
-        """Append/merge op rows (caller holds the lock); returns how many
-        genuinely new ops landed.  Grows the log (2x) instead of silently
-        dropping ops at capacity overflow."""
+    def _pack_accepted_locked(
+        self, accepted: List[Tuple[int, int, int, Dict[str, str]]]
+    ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
+        """Pack accepted rows into merge-ready op columns (caller holds the
+        lock); returns ``(ops, fresh)`` with ``ops=None`` when nothing is
+        fresh.  Shared by the inline ``_ingest`` path and the mesh plane's
+        deferred :meth:`merge_begin`."""
         fresh = 0
-        accepted = self._accept(rows)
         if self._packer is not None:  # native packing path
             for ts, rid, seq, cmd in accepted:
                 for k, v in cmd.items():
                     self._packer.add(ts, rid, seq, k, v)
                     fresh += 1
             if not fresh:
-                return 0
-            ops = self._packer.take()
-        else:
-            cols = {n: [] for n in ("ts", "rid", "seq", "key", "val", "payload", "is_num")}
-            for ts, rid, seq, cmd in accepted:
-                for k, v in cmd.items():
-                    val, payload, is_num = encode_value(v, self.values)
-                    cols["ts"].append(ts)
-                    cols["rid"].append(rid)
-                    cols["seq"].append(seq)
-                    cols["key"].append(self.keys.intern(k))
-                    cols["val"].append(val)
-                    cols["payload"].append(payload)
-                    cols["is_num"].append(is_num)
-                    fresh += 1
-            if not fresh:
-                return 0
-            ops = {
-                n: np.asarray(c, bool if n == "is_num" else np.int32)
-                for n, c in cols.items()
-            }
+                return None, 0
+            return self._packer.take(), fresh
+        cols = {n: [] for n in ("ts", "rid", "seq", "key", "val", "payload", "is_num")}
+        for ts, rid, seq, cmd in accepted:
+            for k, v in cmd.items():
+                val, payload, is_num = encode_value(v, self.values)
+                cols["ts"].append(ts)
+                cols["rid"].append(rid)
+                cols["seq"].append(seq)
+                cols["key"].append(self.keys.intern(k))
+                cols["val"].append(val)
+                cols["payload"].append(payload)
+                cols["is_num"].append(is_num)
+                fresh += 1
+        if not fresh:
+            return None, 0
+        ops = {
+            n: np.asarray(c, bool if n == "is_num" else np.int32)
+            for n, c in cols.items()
+        }
+        return ops, fresh
+
+    def _ingest(self, rows: List[Tuple[int, int, int, Dict[str, str]]]) -> int:
+        """Append/merge op rows (caller holds the lock); returns how many
+        genuinely new ops landed.  Grows the log (2x) instead of silently
+        dropping ops at capacity overflow."""
+        ops, fresh = self._pack_accepted_locked(self._accept_locked(rows))
+        if not fresh:
+            return 0
         self._merge_batch(ops, fresh)
         return fresh
 
     def _ingest_local_batch(
         self, cmds: List[Dict[str, str]], tss: List[int], seq0: int
     ) -> int:
+        ops, fresh = self._pack_local_batch(cmds, tss, seq0)
+        if not fresh:  # all-empty commands: bookkeeping only, no dispatch
+            return 0
+        self._merge_batch(ops, fresh)
+        return fresh
+
+    def _pack_local_batch(
+        self, cmds: List[Dict[str, str]], tss: List[int], seq0: int
+    ) -> Tuple[Optional[Dict[str, np.ndarray]], int]:
         """The ingest admission drain's hot path (caller holds the lock):
         append locally-minted rows (cmds[i] at ts tss[i] with seq
         seq0 + i), already seq-ascending and fresh by construction, so
-        _accept's sort and duplicate/frontier checks are skipped.  Per-op Python cost is trimmed to the bookkeeping gossip
+        _accept_locked's sort and duplicate/frontier checks are skipped.  Per-op Python cost is trimmed to the bookkeeping gossip
         needs (command map, writer index, wire cache); everything else is
         memoized per DISTINCT command dict — op pages share one dict per
         distinct (key, value) pair (OpPage.rows), so the encode/intern
@@ -1133,8 +1382,8 @@ class ReplicaNode:
             seq += 1
         self._vv[rid] = max(self._vv.get(rid, -1), seq - 1)
         fresh = len(c_eidx)
-        if not fresh:  # all-empty commands: bookkeeping only, no dispatch
-            return 0
+        if not fresh:
+            return None, 0
         eidx = np.asarray(c_eidx, np.intp)
         ops = {
             "ts": np.asarray(c_ts, np.int32),
@@ -1145,8 +1394,7 @@ class ReplicaNode:
             "payload": np.asarray(e_pay, np.int32)[eidx],
             "is_num": np.asarray(e_num, bool)[eidx],
         }
-        self._merge_batch(ops, fresh)
-        return fresh
+        return ops, fresh
 
     def _flush_wire_locked(self) -> None:
         """Drain the write-behind wire appends into the native store
@@ -1180,6 +1428,7 @@ class ReplicaNode:
         # packed single-word form) so the union_path counter on /metrics
         # reflects EVERY set-union the node runs, not just ORSet joins
         union_engine.record_union_path("sort")
+        self._count_lane_fold()
         batch = oplog.from_ops(batch_cap, ops)
         timing = self.recorder.enabled
         t0 = time.perf_counter() if timing else 0.0
@@ -1204,3 +1453,13 @@ class ReplicaNode:
         # the old merge-into-bigger-empty paid a full sorted union here)
         self.log = oplog.grow(self.log, self.log.capacity * 2)
         self.metrics.inc("log_grow")
+
+    def _count_lane_fold(self) -> None:
+        # labeled per-lane merge accounting (see _metric_labels): ticks
+        # once per folded lane on BOTH paths, so mesh-vs-host per-shard
+        # attribution matches even though the mesh plane collapses S
+        # lane folds into one device dispatch
+        if self._metric_labels:
+            reg = self.metrics.registry
+            reg.inc("merge_dispatches", 1, **self._metric_labels)
+            reg.inc("union_path", 1, path="sort", **self._metric_labels)

@@ -433,7 +433,8 @@ class NemesisSoak:
                  gc: bool = False,
                  strong: bool = False,
                  crash_coordinator: bool = False,
-                 multitenant: bool = False):
+                 multitenant: bool = False,
+                 ks_mesh: str = "auto"):
         assert nodes >= 2, "nemesis needs a fleet (>= 2 nodes)"
         assert strong or not crash_coordinator, (
             "--crash-coordinator targets the lease plane --strong drives; "
@@ -558,6 +559,11 @@ class NemesisSoak:
                 keyspace_shards=self.MT_SHARDS,
                 keyspace_capacity=max(256, 4 * steps),
                 keyspace_tenant_quota={self.MT_NOISY: self.MT_NOISY_QUOTA},
+                # device-mesh fused shard convergence (parallel.meshplane):
+                # "on" forces the fused path even on one device (vmap
+                # engine) so CI exercises corrupt-shard isolation INSIDE
+                # the fused step deterministically
+                keyspace_mesh=ks_mesh,
             )
         self.config = ClusterConfig(
             n_replicas=nodes, seed=seed,
@@ -2046,14 +2052,15 @@ def run_soak(seed: int, nodes: int, steps: int,
              gc: bool = False,
              strong: bool = False,
              crash_coordinator: bool = False,
-             multitenant: bool = False) -> NemesisReport:
+             multitenant: bool = False,
+             ks_mesh: str = "auto") -> NemesisReport:
     rep = NemesisSoak(seed, nodes=nodes, steps=steps,
                       fault_log=fault_log, postmortem_dir=postmortem_dir,
                       assemble_check=assemble_check,
                       composite=composite, overload=overload,
                       gc=gc, strong=strong,
                       crash_coordinator=crash_coordinator,
-                      multitenant=multitenant).run()
+                      multitenant=multitenant, ks_mesh=ks_mesh).run()
     if gc:
         # shadow arm: the IDENTICAL soak with GC never driven.  The GC
         # drive sits outside the action rng and the fault coins are pure
@@ -2144,6 +2151,12 @@ def main(argv=None) -> int:
                          "tenant may shed/quarantine (tenant-labeled "
                          "events 1:1 vs client counts), and post-heal "
                          "shard-local GC must empty every shard op log")
+    ap.add_argument("--ks-mesh", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="keyspace_mesh knob for --multitenant: route "
+                         "shard convergence through the device-mesh "
+                         "fused step (parallel.meshplane); 'on' forces "
+                         "fusion even on one device")
     ap.add_argument("--race-check", action="store_true",
                     help="run under the witnessed-race detector "
                          "(analysis.verify.race) and fail on any "
@@ -2169,7 +2182,8 @@ def main(argv=None) -> int:
                                gc=args.gc,
                                strong=args.strong or args.crash_coordinator,
                                crash_coordinator=args.crash_coordinator,
-                               multitenant=args.multitenant)
+                               multitenant=args.multitenant,
+                               ks_mesh=args.ks_mesh)
                 run_soak(seed, args.nodes, args.steps, fault_log=log_b,
                          postmortem_dir=args.postmortem_dir,
                          composite=args.composite,
@@ -2177,7 +2191,8 @@ def main(argv=None) -> int:
                          gc=args.gc,
                          strong=args.strong or args.crash_coordinator,
                          crash_coordinator=args.crash_coordinator,
-                         multitenant=args.multitenant)
+                         multitenant=args.multitenant,
+                         ks_mesh=args.ks_mesh)
                 a = pathlib.Path(log_a).read_bytes()
                 b = pathlib.Path(log_b).read_bytes()
                 assert a == b, (
@@ -2195,7 +2210,8 @@ def main(argv=None) -> int:
                            gc=args.gc,
                            strong=args.strong or args.crash_coordinator,
                            crash_coordinator=args.crash_coordinator,
-                           multitenant=args.multitenant)
+                           multitenant=args.multitenant,
+                           ks_mesh=args.ks_mesh)
             print(f"[nemesis] {rep.summary()}")
         if args.race_check:
             rpt = race.report()

@@ -76,6 +76,89 @@ class Ticket:
         return self._result
 
 
+class DrainClaim:
+    """One claimed (popped but not yet drained) lane batch.
+
+    Produced by :meth:`AdmissionQueue.claim` with the lane's drain slot
+    HELD — it stays held until :meth:`resolve` / :meth:`fail`, so late
+    submissions queue behind this drain exactly as they do behind an
+    inline :meth:`AdmissionQueue.flush`.  The resolve path carries the
+    drain accounting (drains/admitted/batch-size/latency counters and
+    per-group ticket slicing) that used to live inside flush()."""
+
+    __slots__ = ("queue", "batch", "flat", "t0", "done")
+
+    def __init__(self, queue: "AdmissionQueue",
+                 batch: List[Tuple[List[Any], Ticket, float, Optional[str]]]):
+        self.queue = queue
+        self.batch = batch
+        flat: List[Any] = []
+        for items, _, _, _ in batch:
+            flat.extend(items)
+        self.flat = flat
+        self.t0 = time.monotonic()
+        self.done = False
+
+    def fail(self, exc: BaseException) -> int:
+        """The drain errored before results existed: every ticket in the
+        batch observes the error (same all-or-nothing the inline flush
+        has) and the drain slot is released."""
+        q = self.queue
+        try:
+            q.metrics.registry.inc(
+                "ingest_drain_errors", lane=q.name, node=q.node)
+            if q.events is not None:
+                q.events.emit("ingest_drain_error", lane=q.name,
+                              n_ops=len(self.flat), error=repr(exc))
+            for _, ticket, _, _ in self.batch:
+                ticket._resolve(None, exc)
+        finally:
+            self.done = True
+            q._drain_lock.release()
+        return len(self.flat)
+
+    def resolve(self, results: Optional[List[Any]]) -> int:
+        """Account the completed drain and hand each group its result
+        slice; releases the drain slot."""
+        q = self.queue
+        flat = self.flat
+        try:
+            t1 = time.monotonic()
+            if results is None:
+                results = [None] * len(flat)
+            assert len(results) == len(flat), (
+                f"lane {q.name!r} flush_fn returned {len(results)} "
+                f"results for {len(flat)} items")
+            reg = q.metrics.registry
+            reg.inc("ingest_drains", lane=q.name, node=q.node)
+            reg.inc("ingest_ops_admitted", float(len(flat)),
+                    lane=q.name, node=q.node)
+            reg.observe("ingest_batch_size", float(len(flat)),
+                        lane=q.name, node=q.node)
+            # admit latency = enqueue -> drain completion, per group (the
+            # flight recorder attributes the in-node half; this histogram
+            # is the front-door half the bench reports)
+            for _, _, t_enq, tenant in self.batch:
+                reg.observe("ingest_admit_latency", t1 - t_enq,
+                            lane=q.name, node=q.node)
+                if tenant is not None:
+                    # the per-tenant SLO view's admit column (obs/fleet):
+                    # a SEPARATE series so the {lane,node} one above
+                    # keeps its label set (dashboards, benches)
+                    reg.observe("ks_admit_latency", t1 - t_enq,
+                                tenant=tenant, node=q.node)
+            reg.observe("ingest_drain_seconds", t1 - self.t0,
+                        lane=q.name, node=q.node)
+            off = 0
+            for items, ticket, _, _ in self.batch:
+                ticket._resolve(results[off:off + len(items)], None)
+                off += len(items)
+        finally:
+            self.done = True
+            q._drain_lock.release()
+        return len(flat)
+
+
 class AdmissionQueue:
     """One bounded micro-batch lane.
 
@@ -155,66 +238,40 @@ class AdmissionQueue:
 
     # ---- drain side ----
 
+    def claim(self) -> Optional["DrainClaim"]:
+        """Pop everything pending WITHOUT running flush_fn, holding this
+        lane's drain slot until the claim resolves or fails.  The fused
+        keyspace drain claims every shard lane first, lands all of them
+        in ONE device-mesh step, then resolves each claim — same
+        accounting and ticket semantics as :meth:`flush`, different
+        dispatch shape.  Returns None (nothing pending, slot released)
+        or a claim the caller MUST resolve/fail."""
+        self._drain_lock.acquire()
+        with self._lock:
+            batch = self._pending
+            if not batch:
+                self._drain_lock.release()
+                return None
+            self._pending = []
+            self._depth = 0
+            self._oldest = None
+            self.metrics.registry.set_gauge(
+                "ingest_queue_depth", 0.0,
+                lane=self.name, node=self.node)
+        return DrainClaim(self, batch)
+
     def flush(self) -> int:
         """Drain everything pending in ONE flush_fn call; returns the op
         count drained.  Concurrent callers serialize; late arrivals land
         in the next drain."""
-        with self._drain_lock:
-            with self._lock:
-                batch = self._pending
-                if not batch:
-                    return 0
-                self._pending = []
-                self._depth = 0
-                self._oldest = None
-                self.metrics.registry.set_gauge(
-                    "ingest_queue_depth", 0.0,
-                    lane=self.name, node=self.node)
-            flat: List[Any] = []
-            for items, _, _, _ in batch:
-                flat.extend(items)
-            reg = self.metrics.registry
-            t0 = time.monotonic()
-            try:
-                results = self.flush_fn(flat)
-            except BaseException as exc:
-                reg.inc("ingest_drain_errors", lane=self.name, node=self.node)
-                if self.events is not None:
-                    self.events.emit("ingest_drain_error", lane=self.name,
-                                     n_ops=len(flat), error=repr(exc))
-                for _, ticket, _, _ in batch:
-                    ticket._resolve(None, exc)
-                return len(flat)
-            t1 = time.monotonic()
-            if results is None:
-                results = [None] * len(flat)
-            assert len(results) == len(flat), (
-                f"lane {self.name!r} flush_fn returned {len(results)} "
-                f"results for {len(flat)} items")
-            reg.inc("ingest_drains", lane=self.name, node=self.node)
-            reg.inc("ingest_ops_admitted", float(len(flat)),
-                    lane=self.name, node=self.node)
-            reg.observe("ingest_batch_size", float(len(flat)),
-                        lane=self.name, node=self.node)
-            # admit latency = enqueue -> drain completion, per group (the
-            # flight recorder attributes the in-node half; this histogram
-            # is the front-door half the bench reports)
-            for _, _, t_enq, tenant in batch:
-                reg.observe("ingest_admit_latency", t1 - t_enq,
-                            lane=self.name, node=self.node)
-                if tenant is not None:
-                    # the per-tenant SLO view's admit column (obs/fleet):
-                    # a SEPARATE series so the {lane,node} one above
-                    # keeps its label set (dashboards, benches)
-                    reg.observe("ks_admit_latency", t1 - t_enq,
-                                tenant=tenant, node=self.node)
-            reg.observe("ingest_drain_seconds", t1 - t0,
-                        lane=self.name, node=self.node)
-            off = 0
-            for items, ticket, _, _ in batch:
-                ticket._resolve(results[off:off + len(items)], None)
-                off += len(items)
-            return len(flat)
+        claim = self.claim()
+        if claim is None:
+            return 0
+        try:
+            results = self.flush_fn(claim.flat)
+        except BaseException as exc:
+            return claim.fail(exc)
+        return claim.resolve(results)
 
     def flush_expired(self, now: Optional[float] = None) -> int:
         """Drain only if the oldest pending group has been waiting past

@@ -85,38 +85,51 @@ class KeyspaceFrontDoor:
 
     # ---- drain side ----
 
+    def _pre_drain(
+        self, items: List[Tuple[Optional[int], Dict[str, str], str]]
+    ) -> Dict[str, int]:
+        """Un-book the drained tenants' quota depth; returns per-tenant
+        drain counts for :meth:`_post_drain`'s accounting."""
+        drained: Dict[str, int] = {}
+        for _, _, tenant in items:
+            drained[tenant] = drained.get(tenant, 0) + 1
+        with self._depth_lock:
+            for tenant, n in drained.items():
+                left = self._tenant_depth.get(tenant, 0) - n
+                if left > 0:
+                    self._tenant_depth[tenant] = left
+                else:
+                    self._tenant_depth.pop(tenant, None)
+        return drained
+
+    def _post_drain(self, shard: int, items: List[Any],
+                    idents: List[Tuple[int, int]],
+                    drained: Dict[str, int]) -> None:
+        reg = self.metrics.registry
+        for tenant, n in drained.items():
+            reg.inc("keyspace_tenant_ops", float(n), tenant=tenant,
+                    node=self.node)
+        if self.events is not None and reg.enabled:
+            # per-drain birth provenance: which tenants this drain
+            # minted how many ops for, joined to the shard recorder's
+            # op_births record by (shard, seq range).  ONE event per
+            # drain — the per-op emission cost stays amortized, and
+            # offline tooling (assemble/fleet) gets per-tenant
+            # expected counts without a dedup table.
+            self.events.emit(
+                "ks_births", shard=shard, n=len(items),
+                seq_first=int(idents[0][1]), seq_last=int(idents[-1][1]),
+                tenants=drained)
+
     def _make_flush(self, shard: int):
         def flush(items: List[Tuple[Optional[int], Dict[str, str], str]]):
-            drained: Dict[str, int] = {}
-            for _, _, tenant in items:
-                drained[tenant] = drained.get(tenant, 0) + 1
-            with self._depth_lock:
-                for tenant, n in drained.items():
-                    left = self._tenant_depth.get(tenant, 0) - n
-                    if left > 0:
-                        self._tenant_depth[tenant] = left
-                    else:
-                        self._tenant_depth.pop(tenant, None)
+            drained = self._pre_drain(items)
             tss = [ts for ts, _, _ in items]
             cmds = [cmd for _, cmd, _ in items]
             idents = self.ks.shards[shard].add_commands(cmds, tss)
             if idents is None:
                 return [None] * len(items)
-            reg = self.metrics.registry
-            for tenant, n in drained.items():
-                reg.inc("keyspace_tenant_ops", float(n), tenant=tenant,
-                        node=self.node)
-            if self.events is not None and reg.enabled:
-                # per-drain birth provenance: which tenants this drain
-                # minted how many ops for, joined to the shard recorder's
-                # op_births record by (shard, seq range).  ONE event per
-                # drain — the per-op emission cost stays amortized, and
-                # offline tooling (assemble/fleet) gets per-tenant
-                # expected counts without a dedup table.
-                self.events.emit(
-                    "ks_births", shard=shard, n=len(items),
-                    seq_first=int(idents[0][1]), seq_last=int(idents[-1][1]),
-                    tenants=drained)
+            self._post_drain(shard, items, idents, drained)
             return idents
         return flush
 
@@ -267,7 +280,62 @@ class KeyspaceFrontDoor:
             return dict(self._tenant_depth)
 
     def flush_all(self) -> int:
+        if self.ks.mesh_active:
+            return self.flush_all_fused()
         return sum(lane.flush() for lane in self.lanes)
+
+    def flush_all_fused(self) -> int:
+        """Drain EVERY shard lane through ONE device-mesh step.
+
+        Shard-aligned drains feed the mesh step: claim all lanes (drain
+        slots, lane index ascending), mint seqs + host bookkeeping per
+        shard (``add_commands_begin``, node locks index ascending —
+        drain locks strictly before node locks, the same order every
+        other path uses), fold all lanes in one ``MeshPlane.converge``
+        dispatch, then resolve every ticket with its idents.  Accounting
+        (drains/admitted/latency, tenant ops, ks_births) is identical to
+        S inline flushes — only the dispatch count changes."""
+        plane = self.ks._plane()
+        if plane is None:
+            return sum(lane.flush() for lane in self.lanes)
+        claims = [lane.claim() for lane in self.lanes]
+        if not any(c is not None for c in claims):
+            return 0
+        pendings: List[Any] = []
+        per_shard: List[Tuple[Any, List[Any], Dict[str, int], Any]] = []
+        for i, claim in enumerate(claims):
+            items = [] if claim is None else claim.flat
+            try:
+                drained = self._pre_drain(items) if items else {}
+                tss = [ts for ts, _, _ in items]
+                cmds = [cmd for _, cmd, _ in items]
+                idents, pending = \
+                    self.ks.shards[i].add_commands_begin(cmds, tss)
+            except BaseException as exc:
+                # this lane's mint failed whole (e.g. out-of-window ts):
+                # its tickets observe the error — exactly what an inline
+                # flush does — and a zero-fresh pending rides along so
+                # the fused step keeps its static lane layout
+                if claim is not None:
+                    claim.fail(exc)
+                    claims[i] = None
+                items, drained = [], {}
+                idents, pending = \
+                    self.ks.shards[i].add_commands_begin([], None)
+            pendings.append(pending)
+            per_shard.append((claims[i], items, drained, idents))
+        plane.converge(pendings)  # commits (or inline-falls-back) + unlocks
+        total = 0
+        for i, (claim, items, drained, idents) in enumerate(per_shard):
+            if claim is None:
+                continue
+            if idents is None:  # shard down: every op in the drain 502s
+                claim.resolve([None] * len(items))
+            else:
+                self._post_drain(i, items, idents, drained)
+                claim.resolve(idents)
+            total += len(items)
+        return total
 
     def flush_expired(self) -> int:
         return sum(lane.flush_expired() for lane in self.lanes)

@@ -65,7 +65,7 @@ class ShardedKeyspace:
     """S independent plane shards + the deterministic router over them."""
 
     def __init__(self, rid: int, n_shards: int, *, capacity: int = 1024,
-                 metrics=None, events=None, clock=None):
+                 metrics=None, events=None, clock=None, mesh: str = "auto"):
         n_shards = int(n_shards)
         if n_shards < 1:
             raise ValueError(
@@ -94,10 +94,107 @@ class ShardedKeyspace:
         for i, shard in enumerate(self.shards):
             shard.recorder.bind(extra={"shard": str(i)},
                                 tenant_of=tenant_of_cmd)
+            # per-shard merge attribution: merge_dispatches{shard=i} /
+            # union_path{shard=i} tick once per folded LANE on both the
+            # host and mesh paths, so the per-shard view survives the
+            # mesh plane collapsing S folds into one device dispatch
+            shard._metric_labels = {"shard": str(i)}
         # level-1 interning: tenant -> small id (accounting only — ids
         # are NEVER stored or gossiped; arrival order may differ per node)
         self._tenants: Dict[str, int] = {}
         self._tenant_lock = threading.Lock()
+        # device-mesh fused convergence (parallel.meshplane): built
+        # lazily on first use so CPU-only processes that never pull
+        # through the mesh path pay nothing
+        self.mesh_mode = mesh
+        self._meshplane = None
+        self._meshplane_lock = threading.Lock()
+
+    # ---- device-mesh plane ----
+
+    def _plane(self):
+        """The lazily-built MeshPlane, or None when the host path is
+        selected (mesh_mode=off, or auto without enough devices/shards).
+        The selection is cached: mode resolution happens once."""
+        if self.mesh_mode == "off":
+            return None
+        with self._meshplane_lock:
+            if self._meshplane is None:
+                from crdt_tpu.parallel.meshplane import (MeshPlane,
+                                                         select_engine)
+                if select_engine(self.n_shards, self.mesh_mode) is None:
+                    self.mesh_mode = "off"  # cache the host-path decision
+                    return None
+                self._meshplane = MeshPlane(
+                    self.n_shards, mode=self.mesh_mode,
+                    metrics=self.shards[0].metrics)
+            return self._meshplane
+
+    @property
+    def mesh_active(self) -> bool:
+        """Does this keyspace fold its shards through the device mesh?"""
+        return self._plane() is not None
+
+    @property
+    def mesh_engine(self) -> Optional[str]:
+        plane = self._plane()
+        return None if plane is None else plane.engine
+
+    def receive_all(self, payloads: List[Optional[Dict[str, Any]]],
+                    quarantine: bool = False) -> List[Any]:
+        """Fold one payload per shard — ALL shards in one fused mesh step
+        when the plane is active, else per-shard host dispatches.
+
+        ``payloads[i]`` lands in shard i (None = nothing for that shard
+        this round).  Returns a per-shard result list: an int (ops
+        absorbed) or, with ``quarantine=True``, an error string for a
+        shard whose payload failed structural validation — that shard's
+        lane folds empty while its SIBLINGS still converge (corrupt-shard
+        isolation inside the fused step).  Without quarantine a bad
+        payload raises after every lane has been safely released."""
+        if len(payloads) != self.n_shards:
+            raise ValueError(
+                f"receive_all needs one payload per shard "
+                f"({self.n_shards}), got {len(payloads)}")
+        plane = self._plane()
+        if plane is None:
+            out: List[Any] = []
+            for shard, p in zip(self.shards, payloads):
+                if p is None:
+                    out.append(0)
+                    continue
+                if quarantine:
+                    err = shard.validate_payload(p)
+                    if err is not None:
+                        out.append(err)
+                        continue
+                out.append(shard.receive(p))
+            return out
+        results: List[Any] = [0] * self.n_shards
+        clean: List[Optional[Dict[str, Any]]] = [None] * self.n_shards
+        for i, (shard, p) in enumerate(zip(self.shards, payloads)):
+            if p is None:
+                continue
+            err = shard.validate_payload(p)
+            if err is not None:
+                if not quarantine:
+                    raise ValueError(
+                        f"shard {i} payload failed validation: {err}")
+                results[i] = err  # lane folds empty; siblings unaffected
+                continue
+            clean[i] = p
+        # lock order: shard index ascending (same as every other
+        # multi-shard path) — merge_begin HOLDS each lock until the
+        # plane's converge commits the lane
+        pendings = [
+            shard.merge_begin([p] if p is not None else [])
+            for shard, p in zip(self.shards, clean)
+        ]
+        plane.converge(pendings)
+        for i, p in enumerate(pendings):
+            if not isinstance(results[i], str):
+                results[i] = p.fresh + p.adopted
+        return results
 
     # ---- routing & interning ----
 
@@ -184,4 +281,5 @@ def keyspace_from_config(rid: int, config, metrics=None, events=None,
         return None
     return ShardedKeyspace(
         rid, n, capacity=int(getattr(config, "keyspace_capacity", 1024)),
-        metrics=metrics, events=events, clock=clock)
+        metrics=metrics, events=events, clock=clock,
+        mesh=str(getattr(config, "keyspace_mesh", "auto")))

@@ -124,6 +124,12 @@ class ClusterConfig:
     # listed here shed on their OWN pending-op depth before the lane
     # fills (a noisy tenant backs off alone).  None/{} = no slices.
     keyspace_tenant_quota: Optional[Dict[str, int]] = None
+    # device-mesh fused convergence (crdt_tpu.parallel.meshplane): fold
+    # all S shards in ONE compiled mesh step instead of S host-driven
+    # dispatches.  "auto" fuses when >= 2 devices and >= 2 shards are
+    # available, "on" always fuses (single device runs the vmap engine),
+    # "off" keeps the per-shard host path.
+    keyspace_mesh: str = "auto"
 
     # ---- consistency plane (crdt_tpu.consistency) ----
     # gossip rounds between stability-GC attempts on the coordinator
@@ -190,6 +196,11 @@ class ClusterConfig:
                         f"keyspace_tenant_quota[{t!r}]={q!r} must be a "
                         "positive int (max pending ops for the tenant's "
                         "quota slice)")
+        if self.keyspace_mesh not in ("auto", "on", "off"):
+            raise ValueError(
+                f"keyspace_mesh={self.keyspace_mesh!r} must be one of "
+                "auto|on|off (auto = fuse shard merges on the device mesh "
+                "when >= 2 devices are available)")
         # lease knobs fail the boot with a named fix too — a zero-slot
         # or zero-duration lease plane is a misconfiguration, never a
         # degraded mode

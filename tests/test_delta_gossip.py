@@ -111,9 +111,41 @@ def test_gossip_after_compaction_ships_summary_not_ops():
     assert FRONTIER_KEY in payload and SUMMARY_KEY in payload
     fresh.receive(payload)
     assert fresh.get_state() == c.nodes[0].get_state()
-    # a requester that already covers the frontier gets neither section
+    # a requester that already covers the frontier still gets the frontier
+    # (it piggybacks on every payload so caught-up peers prune eagerly at
+    # adoption time) but NOT the heavyweight summary sections
     p2 = c.nodes[0].gossip_payload(since=c.nodes[1].version_vector())
-    assert FRONTIER_KEY not in p2 and SUMMARY_KEY not in p2
+    assert FRONTIER_KEY in p2 and SUMMARY_KEY not in p2
+
+
+def test_frontier_piggyback_prunes_caught_up_peer():
+    """Eager pruning below the stable frontier: a caught-up peer adopts a
+    piggybacked frontier WITHOUT summary sections by folding its own raw
+    ops locally, dropping its _commands/_by_writer slices at adoption time
+    — it never has to call compact() itself."""
+    c = _drive(_mk_cluster(), WRITES)
+    assert _converge(c)
+    a, b = c.nodes[0], c.nodes[1]
+    frontier = {r: s for r, s in a.version_vector().items()}
+    a.compact(frontier)
+    assert b._frontier == {} and len(b._commands) == len(WRITES)
+    # b is fully caught up, so a's delta payload to b carries the frontier
+    # but NO summary — and zero raw ops
+    p = a.gossip_payload(since=b.version_vector())
+    assert FRONTIER_KEY in p and SUMMARY_KEY not in p
+    before = dict(b.metrics._counts)  # cluster nodes share one registry
+    absorbed = b.receive(p)
+    assert absorbed == 1  # the adoption counts, no raw ops rode along
+    assert b._frontier == a._frontier
+    # the local fold is bit-identical to a's explicit one
+    assert b._summary == a._summary
+    assert b.get_state() == a.get_state()
+    # and the indexes actually shrank: everything under the frontier is gone
+    assert len(b._commands) == 0
+    assert all(len(lst) == 0 for lst in b._by_writer.values())
+    after = b.metrics._counts
+    assert after.get("frontier_adoptions", 0) - before.get("frontier_adoptions", 0) == 1
+    assert after.get("compactions", 0) == before.get("compactions", 0)
 
 
 def test_dead_node_misses_barrier_then_adopts_summary():
