@@ -324,7 +324,16 @@ class KeyspaceFrontDoor:
                     self.ks.shards[i].add_commands_begin([], None)
             pendings.append(pending)
             per_shard.append((claims[i], items, drained, idents))
-        plane.converge(pendings)  # commits (or inline-falls-back) + unlocks
+        try:
+            plane.converge(pendings)  # commits (or inline-falls-back) + unlocks
+        except BaseException as exc:
+            # converge releases every node lock before re-raising, but the
+            # drain slots are still held — fail every outstanding claim so
+            # waiting tickets observe the error instead of hanging forever
+            for claim, _, _, _ in per_shard:
+                if claim is not None:
+                    claim.fail(exc)
+            raise
         total = 0
         for i, (claim, items, drained, idents) in enumerate(per_shard):
             if claim is None:

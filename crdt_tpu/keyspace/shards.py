@@ -186,10 +186,31 @@ class ShardedKeyspace:
         # lock order: shard index ascending (same as every other
         # multi-shard path) — merge_begin HOLDS each lock until the
         # plane's converge commits the lane
-        pendings = [
-            shard.merge_begin([p] if p is not None else [])
-            for shard, p in zip(self.shards, clean)
-        ]
+        pendings: List[Any] = []
+        try:
+            for i, (shard, p) in enumerate(zip(self.shards, clean)):
+                try:
+                    pendings.append(
+                        shard.merge_begin([p] if p is not None else []))
+                except ValueError as exc:
+                    # adoption-time rejection (incomparable frontier,
+                    # frontier without __summary__) — receiver-state
+                    # dependent, so validate_payload can't pre-screen it.
+                    # merge_begin released shard i's own lock on raise;
+                    # quarantine folds the lane empty so SIBLINGS still
+                    # converge, otherwise re-raise after the cleanup
+                    # below lands the already-held lanes.
+                    if not quarantine:
+                        raise
+                    results[i] = f"{type(exc).__name__}: {exc}"
+                    pendings.append(shard.merge_begin([]))
+        except BaseException:
+            # a lane failed mid-build: land every already-held lane with
+            # its own inline dispatch so no shard lock leaks (a commit
+            # failure there chains onto the original error)
+            from crdt_tpu.parallel.meshplane import land_all_inline
+            land_all_inline(pendings)
+            raise
         plane.converge(pendings)
         for i, p in enumerate(pendings):
             if not isinstance(results[i], str):

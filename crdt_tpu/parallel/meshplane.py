@@ -218,12 +218,11 @@ class MeshPlane:
             raise ValueError(
                 f"mesh plane built for {self.n_lanes} lanes, "
                 f"got {len(pendings)} pendings")
+        if not any(p.fresh for p in pendings):
+            # nothing anywhere: skip the device entirely (the host
+            # path's no-op round does the same)
+            return land_all_inline(pendings)
         try:
-            if not any(p.fresh for p in pendings):
-                # nothing anywhere: skip the device entirely (the host
-                # path's no-op round does the same)
-                return sum(p.commit_inline() for p in pendings)
-
             # uniform lane capacity: vmap stacks to [S, L], so every lane
             # grows (tail padding, lossless) to the max needed, rounded to
             # a power of two to bound recompiles
@@ -255,16 +254,48 @@ class MeshPlane:
             # engine failure: land every lane with its own inline host
             # dispatch so no lane is left with indexes ahead of its log
             self.metrics.inc("meshplane_fallbacks")
-            return sum(p.commit_inline() for p in pendings)
+            return land_all_inline(pendings)
         # one fused device dispatch for ALL lanes — the counter the
         # one-dispatch-per-step assertions pin; per-lane attribution comes
         # from each node's _count_lane_fold (merge_dispatches{shard=i})
         self.metrics.inc("merge_dispatches")
         union_engine.record_union_path(
             "sort", registry=self.metrics.registry)
-        return sum(
-            p.commit(lanes[i], int(n_host[i]))
-            for i, p in enumerate(pendings))
+        total = 0
+        first_exc: Optional[BaseException] = None
+        for i, p in enumerate(pendings):
+            try:
+                total += p.commit(lanes[i], int(n_host[i]))
+            except BaseException as exc:
+                # commit's finally released THIS lane's lock; keep
+                # committing the siblings so none of their locks leak,
+                # then surface the first failure
+                if first_exc is None:
+                    first_exc = exc
+        if first_exc is not None:
+            raise first_exc
+        return total
+
+
+def land_all_inline(pendings: List[Any]) -> int:
+    """Commit every still-open pending with its own inline host dispatch.
+
+    Keeps draining after a lane's ``commit_inline`` raises (its finally
+    already released that lane's lock) so NO lane's node lock leaks, then
+    re-raises the first failure."""
+    total = 0
+    first_exc: Optional[BaseException] = None
+    for p in pendings:
+        if p.done:
+            continue
+        try:
+            total += p.commit_inline()
+        except BaseException as exc:
+            if first_exc is None:
+                first_exc = exc
+    if first_exc is not None:
+        raise first_exc
+    return total
 
 
 def _pad_col(

@@ -302,6 +302,134 @@ def test_lane_count_mismatch_aborts_cleanly():
                if isinstance(r, int)) > 0
 
 
+# ---- failure paths must never leak a lane's node lock ----
+
+def _assert_no_lock_leak(ks: ShardedKeyspace):
+    """Every shard's node lock is free (non-blocking probe — a leaked
+    lock fails the assert instead of hanging the test run)."""
+    for i, shard in enumerate(ks.shards):
+        assert shard._lock.acquire(blocking=False), f"shard {i} lock leaked"
+        shard._lock.release()
+
+
+def test_adoption_failure_quarantines_lane_without_lock_leak():
+    """A payload that PASSES structural validation but fails at ADOPTION
+    time inside merge_begin (non-trivial frontier with no __summary__ —
+    receiver-state dependent, so validate_payload can't pre-screen it)
+    must not leak the earlier lanes' node locks: with quarantine it
+    becomes that lane's error-string result while every sibling still
+    folds; without quarantine it raises only after every already-held
+    lane landed inline."""
+    host, mesh, clock = _twin_keyspaces()
+    writers = _writers(mesh, clock)
+    payloads = _random_round(random.Random(23), mesh, writers, clock,
+                             n_ops=16)
+    bad_shard = 2
+    bad = {"__frontier__": {"7": 5}}  # truncated: frontier, no summary
+    assert mesh.shards[bad_shard].validate_payload(bad) is None
+    payloads[bad_shard] = bad
+    for i, p in enumerate(payloads):
+        if i != bad_shard and p is not None:
+            host.receive(i, p)
+
+    results = mesh.receive_all(payloads, quarantine=True)
+    assert isinstance(results[bad_shard], str)
+    assert "__summary__" in results[bad_shard]
+    for i, r in enumerate(results):
+        if i != bad_shard and payloads[i] is not None:
+            assert isinstance(r, int) and r > 0, f"sibling {i} didn't fold"
+    _assert_no_lock_leak(mesh)
+    # the quarantined lane rode along empty: bit-equal to the host twin
+    # (which never saw the bad payload)
+    _assert_shards_bit_equal(host, mesh)
+
+    # without quarantine the adoption failure propagates — but the lanes
+    # begun before it landed inline and released their locks first
+    payloads2 = _random_round(random.Random(24), mesh, writers, clock)
+    payloads2[bad_shard] = dict(bad)
+    with pytest.raises(ValueError, match="__summary__"):
+        mesh.receive_all(payloads2, quarantine=False)
+    _assert_no_lock_leak(mesh)
+    assert all(isinstance(r, int)
+               for r in mesh.receive_all([None] * N_SHARDS))
+
+
+def test_commit_failure_still_commits_sibling_lanes():
+    """If ONE lane's post-dispatch commit raises (accounting failure),
+    converge still commits every sibling's fused output before
+    re-raising — no sibling is left with its node lock held and its
+    host indexes ahead of its log."""
+    host, mesh, clock = _twin_keyspaces()
+    writers = _writers(mesh, clock)
+    payloads = _random_round(random.Random(31), mesh, writers, clock,
+                             n_ops=16)
+    for i, p in enumerate(payloads):
+        if p is not None:
+            host.receive(i, p)
+    bad = next(i for i, p in enumerate(payloads) if p is not None)
+
+    def boom():
+        raise RuntimeError("injected commit failure")
+
+    mesh.shards[bad]._count_lane_fold = boom
+    try:
+        with pytest.raises(RuntimeError, match="injected commit failure"):
+            mesh.receive_all(payloads)
+    finally:
+        del mesh.shards[bad]._count_lane_fold  # restore the class method
+    _assert_no_lock_leak(mesh)
+    # every lane's fused output committed (the failing lane's log was
+    # rebound before its accounting blew up), so the twin still matches
+    _assert_shards_bit_equal(host, mesh)
+    # and the keyspace folds normally on the next round
+    assert all(isinstance(r, int)
+               for r in mesh.receive_all([None] * N_SHARDS))
+
+
+def test_fused_flush_converge_failure_fails_claims_and_releases_lanes():
+    """flush_all_fused: a converge that re-raises (one lane's commit
+    failed) must fail every outstanding drain claim — waiting tickets
+    observe the error instead of hanging — and release every drain slot
+    and node lock, leaving the door fully usable."""
+    from crdt_tpu.keyspace import KeyspaceFrontDoor
+
+    clock = ManualClock()
+    mesh = ShardedKeyspace(rid=0, n_shards=N_SHARDS, capacity=64,
+                           metrics=Metrics(), clock=clock, mesh="on")
+    door = KeyspaceFrontDoor(mesh, max_batch=1024)
+    groups = {}
+    for i in range(16):
+        key = f"k{i}"
+        shard = mesh.shard_of("t-acme", key)
+        groups.setdefault(shard, []).append(
+            (None, {qualify("t-acme", key): f"v{i}"}, "t-acme"))
+    lane_tickets = door._submit_groups(groups, "t-acme")
+    bad = next(iter(groups))
+
+    def boom():
+        raise RuntimeError("injected commit failure")
+
+    mesh.shards[bad]._count_lane_fold = boom
+    try:
+        with pytest.raises(RuntimeError, match="injected commit failure"):
+            door.flush_all()
+    finally:
+        del mesh.shards[bad]._count_lane_fold
+    for _, ticket in lane_tickets:
+        assert ticket.done, "a drained ticket was left unresolved"
+        with pytest.raises(RuntimeError, match="injected commit failure"):
+            ticket.wait(0)
+    _assert_no_lock_leak(mesh)
+    for lane in door.lanes:
+        assert lane._drain_lock.acquire(blocking=False), \
+            f"lane {lane.name} drain slot leaked"
+        lane._drain_lock.release()
+    # the door keeps admitting and draining after the failed fused flush
+    assert door.admit_kv("t-acme", "fresh-key", "fresh-val",
+                         timeout=5.0) is not None
+    assert mesh.get("t-acme", "fresh-key") == "fresh-val"
+
+
 # ---- served /metrics scrape over a real socket ----
 
 def test_served_scrape_shows_per_shard_counters():
