@@ -1,6 +1,6 @@
 """crdtlint: project-specific static analysis for the TPU-CRDT codebase.
 
-Three layers, one gate (``python -m crdt_tpu.analysis``):
+Four layers, one gate (``python -m crdt_tpu.analysis``):
 
 * AST checkers (ast_checks) — the JAX hazards that bite THIS system:
   donated-buffer reuse, jit/pallas_call construction in per-round loops
@@ -14,6 +14,14 @@ Three layers, one gate (``python -m crdt_tpu.analysis``):
   thread-reachable code without a lock, over a conservative name-based
   call graph seeded at ``threading.Thread`` targets and executor
   submissions.
+* Flow analysis (flow, "crdtflow") — path-sensitive lock discipline and
+  resource typestate with exception edges: lock acquires post-dominated
+  by releases on every path including raises (CRDT210), acquisition
+  order against the declared drain-before-node order plus cycle
+  detection (CRDT211), linear handles (PendingMerge/DrainClaim/Ticket)
+  reaching a terminal on every path (CRDT212), and blocking calls while
+  a node/drain lock is statically held (CRDT213) — the static answer to
+  the mesh-plane leak class the PR-17 review caught by hand.
 
 Above these sits crdtprove (``python -m crdt_tpu.analysis verify``, the
 verify subpackage): exhaustive small-domain lattice-law verification
@@ -50,6 +58,10 @@ RULES = {
     "CRDT106": "PRNG/iota/nondeterministic-reduction primitive inside a join",
     "CRDT107": "narrow-int add/mul inside a join (overflow wrap breaks inflationarity)",
     "CRDT201": "shared mutable state written from thread-reachable code without a lock",
+    "CRDT210": "acquire() not post-dominated by release() on every path (incl. raise edges)",
+    "CRDT211": "lock acquisition against the declared order, or closing an order-graph cycle",
+    "CRDT212": "linear handle (PendingMerge/DrainClaim/Ticket) misses its terminal on a path",
+    "CRDT213": "blocking call (sleep/host-sync/network) while a node or drain lock is held",
     "CRDT301": "registered join refuted by the crdtprove bit-blaster",
     "CRDT302": "registered join missing from (or drifted against) the verdict ledger",
 }
@@ -67,6 +79,10 @@ SEVERITY = {
     "CRDT106": SEV_ERROR,
     "CRDT107": SEV_WARN,
     "CRDT201": SEV_WARN,
+    "CRDT210": SEV_ERROR,
+    "CRDT211": SEV_ERROR,
+    "CRDT212": SEV_ERROR,
+    "CRDT213": SEV_WARN,
     "CRDT301": SEV_ERROR,
     "CRDT302": SEV_ERROR,
 }
@@ -139,7 +155,7 @@ def run_all(roots: Optional[Iterable[pathlib.Path]] = None, *,
     modules; the AST layers need only the standard library).  ``rules``
     filters to a subset of rule IDs.
     """
-    from crdt_tpu.analysis import ast_checks, concurrency
+    from crdt_tpu.analysis import ast_checks, concurrency, flow
 
     root_list = list(roots) if roots is not None else [package_root()]
     rel_base = repo_root()
@@ -147,6 +163,7 @@ def run_all(roots: Optional[Iterable[pathlib.Path]] = None, *,
     files = iter_py_files(root_list)
     findings.extend(ast_checks.check_files(files, rel_base))
     findings.extend(concurrency.check_files(files, rel_base))
+    findings.extend(flow.check_files(files, rel_base))
     if jaxpr:
         from crdt_tpu.analysis import jaxpr_checks
 

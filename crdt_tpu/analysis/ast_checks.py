@@ -37,7 +37,7 @@ import ast
 import pathlib
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from crdt_tpu.analysis import Finding
+from crdt_tpu.analysis import Finding, astcache
 
 #: packages whose files are on the device-dispatch hot path (CRDT003)
 HOT_PACKAGES = ("crdt_tpu/ops/", "crdt_tpu/models/", "crdt_tpu/parallel/")
@@ -353,13 +353,15 @@ ALL_CHECKS = (
 
 def check_file(path: pathlib.Path, rel_base: pathlib.Path) -> List[Finding]:
     relpath = _relpath(path, rel_base)
-    try:
-        src = path.read_text(encoding="utf-8")
-        tree = ast.parse(src)
-    except (OSError, SyntaxError) as e:
-        return [Finding(rule="CRDT000", path=relpath, line=1,
-                        message=f"unparseable: {e}", detail=str(e))]
-    lines = src.splitlines()
+    entry = astcache.load(path)
+    if entry is None:
+        try:  # re-read outside the cache to surface the actual error
+            ast.parse(path.read_text(encoding="utf-8"))
+            return []  # pragma: no cover - raced a concurrent edit
+        except (OSError, SyntaxError) as e:
+            return [Finding(rule="CRDT000", path=relpath, line=1,
+                            message=f"unparseable: {e}", detail=str(e))]
+    tree, lines = entry
     findings: List[Finding] = []
     for check in ALL_CHECKS:
         findings.extend(check(tree, lines, relpath))

@@ -41,7 +41,7 @@ import ast
 import pathlib
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from crdt_tpu.analysis import Finding
+from crdt_tpu.analysis import Finding, astcache
 
 _MUTATORS = {
     "append", "appendleft", "extend", "extendleft", "add", "update",
@@ -249,10 +249,10 @@ def check_files(paths: Iterable[pathlib.Path],
         except ValueError:
             rel = p.as_posix()
         module = rel[:-3].replace("/", ".")
-        try:
-            tree = ast.parse(p.read_text(encoding="utf-8"))
-        except (OSError, SyntaxError):
-            continue
+        entry = astcache.load(p)
+        if entry is None:
+            continue  # ast_checks already surfaced the CRDT000
+        tree = entry[0]
         trees[module] = (tree, rel)
         _index_file(index, tree, module, rel)
     for module, (tree, rel) in trees.items():

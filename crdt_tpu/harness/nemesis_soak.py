@@ -2223,16 +2223,33 @@ def main(argv=None) -> int:
                 "race detector observed zero watched accesses: "
                 "instrumentation dead or watch list empty"
             )
+            # cross-check seam: map every runtime witness back to the
+            # static CRDT210-213 finding covering its frames (crdtflow).
+            # A witness the static pass has no finding for is a GAP in
+            # the lock-discipline analysis — say so loudly either way.
+            from crdt_tpu.analysis import flow as flow_mod
+            rpt["flow"] = flow_mod.bridge_report(rpt["witnesses"])
             if rpt["witness_count"]:
-                for w in rpt["witnesses"]:
+                for w, m in zip(rpt["witnesses"], rpt["flow"]["mapped"]):
                     print(w)
+                    if m["covered"]:
+                        print("[nemesis] flow: witness covered by "
+                              + "; ".join(m["covered_by"]))
+                    else:
+                        print("[nemesis] flow: witness UNCOVERED by "
+                              "crdtflow (CRDT210-213) — static "
+                              "lock-discipline analysis has a blind spot "
+                              "here; file it against analysis/flow.py")
                 raise AssertionError(
                     f"seed {seed}: {rpt['witness_count']} witnessed "
-                    f"race(s) on shared runtime state (above)"
+                    f"race(s) on shared runtime state (above); "
+                    f"{rpt['flow']['uncovered_count']} uncovered by "
+                    f"static flow analysis"
                 )
             print(f"[nemesis] race-check OK: 0 witnesses over "
                   f"{reads} reads / {writes} writes across "
-                  f"{len(rpt['access_counts'])} watchpoints")
+                  f"{len(rpt['access_counts'])} watchpoints "
+                  f"(flow cross-check: nothing to map)")
             race.reset()
     return 0
 

@@ -247,18 +247,25 @@ class AdmissionQueue:
         dispatch shape.  Returns None (nothing pending, slot released)
         or a claim the caller MUST resolve/fail."""
         self._drain_lock.acquire()
-        with self._lock:
-            batch = self._pending
-            if not batch:
-                self._drain_lock.release()
-                return None
-            self._pending = []
-            self._depth = 0
-            self._oldest = None
-            self.metrics.registry.set_gauge(
-                "ingest_queue_depth", 0.0,
-                lane=self.name, node=self.node)
-        return DrainClaim(self, batch)
+        try:
+            with self._lock:
+                batch = self._pending
+                if not batch:
+                    self._drain_lock.release()
+                    return None
+                self._pending = []
+                self._depth = 0
+                self._oldest = None
+                self.metrics.registry.set_gauge(
+                    "ingest_queue_depth", 0.0,
+                    lane=self.name, node=self.node)
+            return DrainClaim(self, batch)
+        except BaseException:
+            # gauge plumbing or claim construction failed: the drain slot
+            # must not leak (a leaked slot deadlocks every future drain
+            # of this lane) — CRDT210's raise-edge obligation
+            self._drain_lock.release()
+            raise
 
     def flush(self) -> int:
         """Drain everything pending in ONE flush_fn call; returns the op

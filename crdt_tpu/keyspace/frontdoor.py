@@ -298,7 +298,19 @@ class KeyspaceFrontDoor:
         plane = self.ks._plane()
         if plane is None:
             return sum(lane.flush() for lane in self.lanes)
-        claims = [lane.claim() for lane in self.lanes]
+        # drain slots, lane index ascending — built INCREMENTALLY so a
+        # claim failing mid-sweep can fail (and release) every slot
+        # already held; a comprehension here is the PR-17 leak shape and
+        # trips CRDT212
+        claims: List[Optional[Any]] = []
+        try:
+            for lane in self.lanes:
+                claims.append(lane.claim())
+        except BaseException as exc:
+            for claim in claims:
+                if claim is not None:
+                    claim.fail(exc)
+            raise
         if not any(c is not None for c in claims):
             return 0
         pendings: List[Any] = []

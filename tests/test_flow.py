@@ -1,0 +1,416 @@
+"""crdtflow self-tests: the three PR-17-review lock-leak bugs,
+reintroduced verbatim as fixtures, are each flagged by the matching rule
+(CRDT212, CRDT210, CRDT212), clean shapes stay clean (`with` blocks,
+``_locked`` callees, ``land_all_inline``-style drain helpers, the fixed
+incremental builds), and the race-detector bridge maps witnesses to
+covering findings.
+"""
+import textwrap
+
+from crdt_tpu import analysis
+from crdt_tpu.analysis import Finding, flow
+
+
+def _flow_snippet(tmp_path, source, relpath="fixture.py"):
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return flow.check_files([p], tmp_path)
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ------------------------------------------------ the three PR-17 bugs
+
+def test_pr17_bug1_comprehension_built_lane_list(tmp_path):
+    """Bug 1: PendingMerge lanes built in a comprehension — a failure
+    mid-build leaks every earlier shard's held node lock (CRDT212)."""
+    findings = _flow_snippet(tmp_path, """
+        def receive_all(self, payloads):
+            pendings = [shard.merge_begin([p])
+                        for shard, p in zip(self.shards, payloads)]
+            return self.plane.converge(pendings)
+    """)
+    assert "CRDT212" in _rules(findings)
+    (f,) = [f for f in findings if f.rule == "CRDT212"]
+    assert "comprehension" in f.message
+    assert "_lock" in f.message
+    assert f.severity == "error"
+
+
+def test_pr17_bug2_first_failure_commit_sweep(tmp_path):
+    """Bug 2: the commit sweep stops at the first failing lane — locks
+    acquired for the later lanes are never released (CRDT210)."""
+    findings = _flow_snippet(tmp_path, """
+        def converge(self, a, b):
+            a._lock.acquire()
+            b._lock.acquire()
+            total = a.commit_rows()    # first failure aborts the sweep
+            total += b.commit_rows()
+            a._lock.release()
+            b._lock.release()
+            return total
+    """)
+    assert "CRDT210" in _rules(findings)
+    assert any("exception path" in f.message for f in findings
+               if f.rule == "CRDT210")
+
+
+def test_pr17_bug3_unresolved_claims_on_converge_error(tmp_path):
+    """Bug 3: converge raises after the lanes were claimed — the
+    DrainClaims are never resolved/failed and their drain slots (and the
+    tickets waiting on them) hang forever (CRDT212)."""
+    findings = _flow_snippet(tmp_path, """
+        def flush_fused(self, lane, plane, pendings):
+            claim = lane.claim()
+            plane.converge(pendings)   # raises -> claim leaks
+            return claim.resolve([])
+    """)
+    assert "CRDT212" in _rules(findings)
+    (f,) = [f for f in findings if f.rule == "CRDT212"]
+    assert "DrainClaim" in f.message and "exception path" in f.message
+
+
+# ------------------------------------------- the fixed shapes are clean
+
+def test_pr17_fix1_incremental_build_with_landing_is_clean(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def receive_all(self, shards):
+            pendings = []
+            try:
+                for shard in shards:
+                    pendings.append(shard.merge_begin([]))
+            except BaseException:
+                self.land_all_inline(pendings)
+                raise
+            return self.plane.converge(pendings)
+    """)
+    assert findings == []
+
+
+def test_pr17_fix2_per_lane_try_finally_sweep_is_clean(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def converge(self, lanes):
+            total = 0
+            for lane in lanes:
+                lane._lock.acquire()
+                try:
+                    total += lane.commit_rows()
+                finally:
+                    lane._lock.release()
+            return total
+    """)
+    assert findings == []
+
+
+def test_pr17_fix3_claim_guarded_by_fail_is_clean(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def flush_fused(self, lane, plane, pendings):
+            claim = lane.claim()
+            if claim is None:
+                return 0
+            try:
+                plane.converge(pendings)
+            except BaseException as exc:
+                return claim.fail(exc)
+            return claim.resolve([])
+    """)
+    assert findings == []
+
+
+# ------------------------------------------------------------- CRDT210
+
+def test_bare_acquire_with_raising_call_leaks(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def poke(self):
+            self._lock.acquire()
+            self.refresh()
+            self._lock.release()
+    """)
+    assert "CRDT210" in _rules(findings)
+
+
+def test_try_finally_release_discharges(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def poke(self):
+            self._lock.acquire()
+            try:
+                self.refresh()
+            finally:
+                self._lock.release()
+    """)
+    assert findings == []
+
+
+def test_with_block_discharges(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def poke(self):
+            with self._lock:
+                self.refresh()
+    """)
+    assert findings == []
+
+
+def test_creator_convention_returns_holding(tmp_path):
+    """merge_begin-style creators RETURN holding their lock by contract:
+    the normal exit is exempt, but an unguarded raise edge still flags."""
+    clean = _flow_snippet(tmp_path, """
+        def merge_begin(self, batch):
+            self._lock.acquire()
+            try:
+                self._accept(batch)
+                pending = PendingMerge(self)
+            except BaseException:
+                self._lock.release()
+                raise
+            return pending
+    """)
+    assert clean == []
+    leaky = _flow_snippet(tmp_path, """
+        def merge_begin(self, batch):
+            self._lock.acquire()
+            self._accept(batch)
+            return PendingMerge(self)
+    """, relpath="leaky.py")
+    assert "CRDT210" in _rules(leaky)
+
+
+def test_door_lock_recognized_via_threading_registry(tmp_path):
+    """``self._adm`` has no 'lock' in its name — it's recognized as a
+    lock because __init__ assigns it ``threading.Lock()``."""
+    findings = _flow_snippet(tmp_path, """
+        import threading
+
+        class Door:
+            def __init__(self):
+                self._adm = threading.Lock()
+
+            def submit(self):
+                self._adm.acquire()
+                self.push()
+                self._adm.release()
+    """)
+    assert "CRDT210" in _rules(findings)
+
+
+def test_locked_callee_convention_is_clean(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def update(self):
+            with self._lock:
+                self._bump_locked()
+
+        def _bump_locked(self):
+            self.n += 1
+    """)
+    assert findings == []
+
+
+# ------------------------------------------------------------- CRDT211
+
+def test_declared_order_violation_node_before_drain(tmp_path):
+    """parallel/README.md declares drain (lane) locks strictly before
+    node locks — acquiring _drain_lock under _lock is flagged."""
+    findings = _flow_snippet(tmp_path, """
+        def backwards(self, lane):
+            self._lock.acquire()
+            try:
+                lane._drain_lock.acquire()
+                try:
+                    self.fold()
+                finally:
+                    lane._drain_lock.release()
+            finally:
+                self._lock.release()
+    """)
+    flagged = [f for f in findings if f.rule == "CRDT211"]
+    assert flagged and "declared" in flagged[0].message
+
+
+def test_declared_order_respected_is_clean(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def forwards(self, lane):
+            lane._drain_lock.acquire()
+            try:
+                with self._lock:
+                    self.fold()
+            finally:
+                lane._drain_lock.release()
+    """)
+    assert findings == []
+
+
+def test_order_cycle_flagged(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def one(self):
+            with self._alock:
+                with self._block:
+                    self.a()
+
+        def two(self):
+            with self._block:
+                with self._alock:
+                    self.b()
+    """)
+    flagged = [f for f in findings if f.rule == "CRDT211"]
+    assert flagged and any("cycle" in f.message for f in flagged)
+
+
+# ------------------------------------------------------------- CRDT212
+
+def test_dropped_claim_flagged(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def fire(self, lane):
+            lane.claim()
+    """)
+    assert "CRDT212" in _rules(findings)
+    assert "discarded" in findings[0].message
+
+
+def test_ticket_normal_path_drop_flagged(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        def admit(self, q):
+            t = q.submit_many([1])
+            if self.closed:
+                return None
+            return t.wait(1.0)
+    """)
+    assert "CRDT212" in _rules(findings)
+
+
+def test_ticket_exception_paths_are_exempt(tmp_path):
+    """A Ticket abandoned by an exception sheds cooperatively (its lane
+    flushes on deadline) — only normal-path drops flag."""
+    findings = _flow_snippet(tmp_path, """
+        def admit(self, q):
+            t = q.submit_many([1])
+            self.account()
+            return t.wait(5.0)
+    """)
+    assert findings == []
+
+
+def test_ticket_comprehension_is_clean(tmp_path):
+    """Tickets hold no lock: building them in a comprehension (what
+    ``_submit_groups`` does under the door lock) is fine."""
+    findings = _flow_snippet(tmp_path, """
+        def submit_groups(self, groups):
+            with self._adm_lock:
+                return [q.submit_many(items) for q, items in groups]
+    """)
+    assert findings == []
+
+
+def test_escape_transfers_obligation(tmp_path):
+    """Handles returned/stored/passed to a callee are the new owner's
+    problem — the land_all_inline-style helper over a pendings param is
+    clean, and so is handing a bound claim off."""
+    findings = _flow_snippet(tmp_path, """
+        def land_all_inline(pendings):
+            total = 0
+            for p in pendings:
+                total += p.commit_inline()
+            return total
+
+        def handoff(self, lane):
+            claim = lane.claim()
+            self.landings.append(claim)
+            return self.drain_later()
+    """)
+    assert findings == []
+
+
+# ------------------------------------------------------------- CRDT213
+
+def test_host_sync_under_node_lock_flagged(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        import numpy as np
+
+        def snapshot(self):
+            with self._lock:
+                return np.asarray(self.rows)
+    """)
+    assert _rules(findings) == ["CRDT213"]
+    assert findings[0].severity == "warn"
+
+
+def test_transitive_blocking_under_lock_flagged(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        import time
+
+        class Lane:
+            def settle(self):
+                time.sleep(0.1)
+
+            def drain(self, other):
+                other._drain_lock.acquire()
+                try:
+                    self.settle()
+                finally:
+                    other._drain_lock.release()
+    """)
+    assert _rules(findings) == ["CRDT213"]
+
+
+def test_blocking_outside_sensitive_locks_is_clean(tmp_path):
+    findings = _flow_snippet(tmp_path, """
+        import numpy as np
+        import time
+
+        def poll(self):
+            time.sleep(0.1)
+            return np.asarray(self.rows)
+
+        def account(self):
+            with self._gauge_lock:
+                self.n += 1
+    """)
+    assert findings == []
+
+
+# ------------------------------------------------------- rules & bridge
+
+def test_flow_rules_are_listed():
+    for rule in ("CRDT210", "CRDT211", "CRDT212", "CRDT213"):
+        assert rule in analysis.RULES
+    assert analysis.SEVERITY["CRDT210"] == "error"
+    assert analysis.SEVERITY["CRDT211"] == "error"
+    assert analysis.SEVERITY["CRDT212"] == "error"
+    assert analysis.SEVERITY["CRDT213"] == "warn"
+
+
+def test_bridge_maps_witness_to_covering_finding():
+    finding = Finding(rule="CRDT210", path="crdt_tpu/ingest/admission.py",
+                      line=249, scope="AdmissionQueue.claim",
+                      message="m", detail="self._drain_lock|raise")
+    covered = flow.map_witnesses(
+        ["race on AdmissionQueue._pending:\n"
+         "  writer: crdt_tpu/ingest/admission.py:251 in claim\n"
+         "  reader: crdt_tpu/ingest/admission.py:210 in submit_many"],
+        findings=[finding])
+    (m,) = covered
+    assert m["covered"] and "CRDT210" in m["covered_by"][0]
+
+    uncovered = flow.map_witnesses(
+        ["race on Metrics._vals:\n"
+         "  writer: crdt_tpu/utils/metrics.py:60 in inc"],
+        findings=[finding])
+    assert uncovered[0]["covered"] is False
+
+
+def test_bridge_report_shape():
+    rpt = flow.bridge_report([])
+    assert rpt == {"witness_count": 0, "mapped": [], "uncovered_count": 0}
+
+
+# ----------------------------------------------------------- tree smoke
+
+def test_flow_layer_runs_over_package_without_errors():
+    """The shipped tree is CRDT210/211/212-clean (errors are fixed, not
+    baselined) — the flow half of the clean-tree invariant."""
+    findings = flow.check_files(
+        analysis.iter_py_files([analysis.package_root()]),
+        analysis.repo_root())
+    errors = [f for f in findings if f.severity == "error"]
+    assert errors == [], "\n".join(f.render() for f in errors)
