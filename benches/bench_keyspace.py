@@ -22,7 +22,7 @@ producers — and each admitted group drains as ONE dispatch into ONE
 shard.  A shard-oblivious client still converges identically; it just
 pays splits at the door instead of at the producer.
 
-Three phases:
+Phases (parity and scaling always; the rest opt-in):
 
 * **parity** — one multi-tenant stream through an S=4 keyspace door:
   per-tenant views must equal the client-side fold exactly, dispatch
@@ -35,6 +35,13 @@ Three phases:
   dispatches per arm; rep 0 of each arm is an uncounted warm-up that
   absorbs jit compilation for that arm's K/S shapes.  The gate
   (--assert-scaling) requires wps_S >= eff * S * wps_1 for S=4.
+* **reshard** (``--reshard``) — the online 2 -> 4 migration window,
+  live under writes: half the stream lands pre-window, half is
+  admitted THROUGH the open MIGRATE window (dual-route: old owners),
+  and the measured span is start -> cutover return.  Zero lost or
+  duplicated keys vs the client fold and DISJOINT post-cutover
+  ownership are asserted every rep; the median window lands in
+  ``keyspace_reshard_window_s`` for the baseline gate.
 * **mesh** (``--mesh``) — the anti-entropy A/B: identical per-shard
   delta-gossip rounds folded through the device-mesh plane
   (parallel.meshplane: ONE fused dispatch converges all S shards) vs
@@ -167,6 +174,85 @@ def _check_parity(stream, total_capacity: int, batch: int) -> int:
                 == ks.shards[i].version_vector()), (
             f"shard {i} vv diverged after full-payload receive")
     return n_groups
+
+
+# ---- reshard phase: live 2 -> 4 under writes, window measured ----
+
+def _run_reshard_rep(pre_groups, live_groups, expected,
+                     total_capacity: int, batch: int):
+    """One rep: build S=2, admit the pre-window stream, then measure
+    the MIGRATE window — start(4), keep admitting the live stream
+    through the open window (dual-route: writes land in their OLD
+    owner and are folded at cutover), cutover.  Oracles after the
+    swap: per-tenant fold equality (zero lost, zero duplicated),
+    per-shard ownership DISJOINT under the new router, epoch bumped."""
+    from crdt_tpu.keyspace import route_key, split_qualified
+
+    ks, door = _fresh_door(2, total_capacity, batch)
+    for tenant, cmd in pre_groups:
+        door.admit_cmd(tenant, cmd, timeout=30.0)
+    t0 = time.perf_counter()
+    st = ks.reshard.start(4)
+    for tenant, cmd in live_groups:  # writes DURING the window
+        door.admit_cmd(tenant, cmd, timeout=30.0)
+    cut = ks.reshard.cutover()
+    window = time.perf_counter() - t0
+    assert cut["epoch"] == 1 and cut["n_shards"] == 4
+    for tenant, fold in expected.items():
+        got = ks.tenant_state(tenant)
+        assert got == fold, (
+            f"tenant {tenant!r} diverged across the reshard: "
+            f"missing={sorted(set(fold) - set(got))[:5]} "
+            f"extra={sorted(set(got) - set(fold))[:5]}")
+    n_keys = 0
+    for i, shard in enumerate(ks.shards):
+        state = shard.get_state()
+        n_keys += len(state)
+        for qkey in state:
+            tenant, key = split_qualified(qkey)
+            owner = ks.router.owner_index(route_key(tenant, key))
+            assert owner == i, (
+                f"{qkey!r} materialized at shard {i}, owned by {owner}")
+    assert n_keys == sum(len(f) for f in expected.values()), (
+        f"{n_keys} keys across shards vs "
+        f"{sum(len(f) for f in expected.values())} in the client fold "
+        "— a key landed at two shards or vanished")
+    return window, int(st["moved"]), int(cut["minted"])
+
+
+def _check_reshard(n_ops: int, total_capacity: int, batch: int,
+                   reps: int, seed: int, rows: list):
+    stream = _stream(n_ops, seed, tenants=TENANTS)
+    split = n_ops // 2
+    expected = {t: {} for t in TENANTS}
+    for tenant, key, value in stream:
+        expected[tenant][key] = value
+    # partition both halves against a throwaway S=2 keyspace: the live
+    # half keeps routing by the OLD map — exactly what an un-fenced
+    # writer does mid-window — and the door's dual-route contract is
+    # what the fold-equality oracle then proves
+    ks0, _ = _fresh_door(2, total_capacity, batch)
+    pre_groups = _partition(stream[:split], ks0, batch)
+    live_groups = _partition(stream[split:], ks0, batch)
+    windows = []
+    moved = minted = 0
+    for rep in range(reps + 1):  # rep 0 = uncounted warm-up (jit at S'=4)
+        window, moved, minted = _run_reshard_rep(
+            pre_groups, live_groups, expected, total_capacity, batch)
+        if rep == 0:
+            continue
+        windows.append(window)
+        rows.append({"phase": "reshard", "rep": rep,
+                     "window_s": round(window, 4),
+                     "moved": moved, "minted": minted})
+    rows.append({
+        "bench": "keyspace_reshard",
+        "n_ops": n_ops, "total_capacity": total_capacity,
+        "shards_from": 2, "shards_to": 4,
+        "reshard_window_s": round(statistics.median(windows), 4),
+        "moved": moved, "minted": minted,
+        "zero_lost_or_dup": True,  # the rep oracles would have raised
+    })
 
 
 # ---- mesh phase: device-mesh fold vs S host dispatches ----
@@ -309,6 +395,11 @@ def main() -> int:
     ap.add_argument("--mesh", action="store_true",
                     help="run the device-mesh anti-entropy A/B phase "
                          "(fused meshplane fold vs S host dispatches)")
+    ap.add_argument("--reshard", action="store_true",
+                    help="run the online-reshard phase: live 2 -> 4 "
+                         "shard migration under writes; measures the "
+                         "MIGRATE window and asserts zero lost/dup "
+                         "keys + disjoint post-cutover ownership")
     ap.add_argument("--mesh-rounds", type=int, default=24,
                     help="gossip rounds per mesh-phase rep")
     ap.add_argument("--mesh-ops", type=int, default=32,
@@ -364,7 +455,12 @@ def main() -> int:
                          "shard_capacity": args.capacity // n_shards})
         walls[n_shards] = statistics.median(arm_walls)
 
-    # ---- phase 3: device-mesh anti-entropy A/B (opt-in) ----
+    # ---- phase 3: online reshard window (opt-in) ----
+    if args.reshard:
+        _check_reshard(args.n_parity, args.capacity, args.batch,
+                       args.reps, args.seed, rows)
+
+    # ---- phase 4: device-mesh anti-entropy A/B (opt-in) ----
     if args.mesh:
         # per-shard capacity sized so a rep never grows mid-round (growth
         # is lossless but changes compiled shapes; the warm-up rep then

@@ -119,15 +119,28 @@ the X-CRDT-Tenant request header: /data, /ingest/page and /map/upd with
 the header route through the tenant door (rendezvous-sharded, per-tenant
 quota); without the header they keep the single-plane path:
   GET  /ks/gossip?shard=i[&vv=] one SHARD's delta payload + its
-                                stability summary in the body
-                                ({"payload","vv","frontier"})
+             [&epoch=e]         stability summary in the body
+                                ({"payload","vv","frontier"}); a stale
+                                reshard epoch 409s naming the live one
   GET  /ks/data[?tenant=t]      tenant's materialized state, or the
                                 per-shard stats without ?tenant
-  POST /ks/compact              {"shard": i, "frontier": {rid: seq}} ->
-                                fold ONE shard (shard-local GC)
+  POST /ks/compact              {"shard": i, "frontier": {rid: seq},
+                                "epoch": e?} -> fold ONE shard (shard-
+                                local GC); stale epoch 409s
+  POST /ks/migrate              {"shard": dst, "epoch": e, "payload":
+                                wire} -> fold one reshard migration
+                                slice into the MIGRATE buffer; 409 when
+                                not migrating at e, 400 quarantine on a
+                                corrupt slice
   POST /admin/ks_pull           {"peer": url?} -> one keyspace pull now
   POST /admin/ks_gc             one shard-local stability-GC round now
                                 (coordinator)
+  POST /admin/ks_reshard        {"action": "start"|"stream"|"cutover"|
+                                "abort"|"status", "shards": S'?} ->
+                                drive the online-reshard state machine
+                                (keyspace/reshard.py)
+  (tenant-scoped POST /ingest/page may stamp X-CRDT-KS-Epoch; a stale
+  stamp 409s instead of admitting against a moved shard map)
 
 The /condition route takes the flag as a path segment (also accepted:
 ?alive_status=) — the reference registered the route without the parameter
@@ -156,6 +169,11 @@ from crdt_tpu.obs import health
 from crdt_tpu.obs.trace import TRACE_HEADER, span
 
 PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# optional reshard-epoch stamp on tenant-scoped page admits: a stamped
+# page 409s when the writer's epoch is stale (see keyspace/reshard.py);
+# an un-stamped page routes by the live shard map, back-compatible
+KS_EPOCH_HEADER = "X-CRDT-KS-Epoch"
 
 
 def _make_handler(cluster: LocalCluster, idx: int, admin=None):
@@ -442,6 +460,17 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     except (TypeError, ValueError, AssertionError):
                         self._send(400, "invalid shard")
                         return
+                    # reshard epoch fence: a puller at another epoch gets
+                    # a 409 naming ours (its (rid, seq) identities belong
+                    # to a different plane generation).  No ?epoch= means
+                    # epoch 0 — back-compatible until the first reshard.
+                    fence = ks.check_epoch(
+                        q.get("epoch", [None])[0], "ks_gossip",
+                        peer=self.client_address[0])
+                    if fence is not None:
+                        self._send(409, json.dumps(fence),
+                                   "application/json")
+                        return
                     since = self._parse_vv_query(url)
                     if since == "bad":
                         self._send(400, "invalid vv")
@@ -683,6 +712,22 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 tenant = self.headers.get(TENANT_HEADER)
                 try:
                     if tenant is not None and self.ks_door is not None:
+                        # reshard epoch fence on the page-admit surface:
+                        # a writer that STAMPS its epoch (the header is
+                        # optional — un-stamped writers predate the
+                        # fence and route by the live map either way)
+                        # gets a 409 naming ours when stale, so a
+                        # mid-reshard client learns the map moved
+                        # instead of silently writing against it
+                        eh = self.headers.get(KS_EPOCH_HEADER)
+                        if eh is not None:
+                            fence = self.keyspace.check_epoch(
+                                eh, "ingest_page",
+                                peer=self.client_address[0])
+                            if fence is not None:
+                                self._send(409, json.dumps(fence),
+                                           "application/json")
+                                return
                         # tenant-scoped page: rendezvous fan-out across
                         # shard lanes, per-tenant quota, whole-page shed
                         out = self.ks_door.admit_page(raw, tenant)
@@ -784,6 +829,14 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         fresh = admin.admin_ks_pull(body.get("peer"))
                         self._send(200, json.dumps({"fresh": int(fresh)}),
                                    "application/json")
+                    elif path == "/admin/ks_reshard":
+                        try:
+                            out = admin.admin_ks_reshard(body)
+                        except ValueError as e:
+                            self._send(400, str(e))
+                        else:
+                            self._send(200, json.dumps(out),
+                                       "application/json")
                     elif path == "/admin/ks_gc":
                         folded = admin.admin_ks_gc()
                         self._send(
@@ -1057,8 +1110,56 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 if not self.node.alive:
                     self._send(502, "Unreachable")
                     return
+                # reshard epoch fence: a frontier minted against another
+                # plane generation must never fold this one (the (rid,
+                # seq) spaces were reborn at cutover).  Absent epoch =
+                # epoch 0, back-compatible until the first reshard.
+                fence = ks.check_epoch(body.get("epoch"), "ks_compact",
+                                       peer=self.client_address[0])
+                if fence is not None:
+                    self._send(409, json.dumps(fence), "application/json")
+                    return
                 ks.compact_shard(shard, frontier)
                 self._send(200, "OK")
+                return
+            if path == "/ks/migrate":
+                ks = self.keyspace
+                if ks is None:
+                    self._send(404, "no keyspace tier on this node")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    shard = int(body.get("shard"))
+                    payload = body.get("payload")
+                    assert isinstance(payload, dict)
+                except Exception:
+                    self._send(400, "invalid shard/payload")
+                    return
+                if not self.node.alive:
+                    self._send(502, "Unreachable")
+                    return
+                # epoch match AND this node must be IN its own MIGRATE
+                # window — both refusals use the same 409 grammar naming
+                # the live epoch, so the sender knows to retry later
+                # (peer not told yet) or stand down (already cut over)
+                fence = ks.check_epoch(body.get("epoch"), "ks_migrate",
+                                       peer=self.client_address[0])
+                if fence is not None:
+                    self._send(409, json.dumps(fence), "application/json")
+                    return
+                out = ks.reshard.receive_migration(
+                    shard, payload, peer=self.client_address[0])
+                if out.get("ok"):
+                    self._send(200, json.dumps(out), "application/json")
+                elif "quarantined" in out:
+                    self._send(400, json.dumps(out), "application/json")
+                else:
+                    # not in a MIGRATE window at this epoch: same 409
+                    # grammar as the fence (the sender retries later)
+                    out["fenced"] = True
+                    out["epoch"] = ks.epoch
+                    self._send(409, json.dumps(out), "application/json")
                 return
             if path == "/compact":
                 n = int(self.headers.get("Content-Length", 0))

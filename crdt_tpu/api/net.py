@@ -31,7 +31,7 @@ import urllib.parse
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from crdt_tpu.api.node import (
     ReplicaNode,
@@ -112,6 +112,14 @@ class RemotePeer:
         # subjects summaries to the same drop/delay schedule as bodies.
         self._stability_lock = threading.Lock()
         self._stability_raw: Optional[str] = None
+        # last HTTP error status+body captured by _get (the base GET
+        # path discards non-200 statuses — fine for gossip, but the
+        # reshard epoch fence answers 409 with a body naming the
+        # current epoch, and the puller must SEE it to count the fence
+        # instead of mistaking it for a dead peer).  Pop semantics via
+        # take_http_error, same posture as the stability slot.
+        self._http_err_lock = threading.Lock()
+        self._http_err: Optional[Tuple[int, Optional[dict]]] = None
 
     def _note_reachable(self) -> None:
         with self._backoff_lock:
@@ -184,6 +192,20 @@ class RemotePeer:
             raw, self._stability_raw = self._stability_raw, None
         return decode_summary(raw)
 
+    def take_http_error(self) -> Optional[Tuple[int, Optional[dict]]]:
+        """Pop the (status, parsed-body) of the last HTTP error a _get
+        observed, or None.  Callers that care (the epoch-fenced keyspace
+        pulls) CLEAR the slot before their request and pop right after,
+        so a stale capture from an unrelated leg cannot masquerade as
+        this round's refusal."""
+        with self._http_err_lock:
+            got, self._http_err = self._http_err, None
+        return got
+
+    def _clear_http_error(self) -> None:
+        with self._http_err_lock:
+            self._http_err = None
+
     def _get(self, path: str,
              headers: Optional[Dict[str, str]] = None) -> Optional[bytes]:
         req = urllib.request.Request(self.url + path, headers=headers or {})
@@ -194,8 +216,15 @@ class RemotePeer:
                 if stab is not None:
                     with self._stability_lock:
                         self._stability_raw = stab
-        except urllib.error.HTTPError:
+        except urllib.error.HTTPError as e:
             self._note_reachable()  # served an error status: peer is UP
+            try:
+                parsed = json.loads(e.read())
+            except (ValueError, OSError):
+                parsed = None
+            with self._http_err_lock:
+                self._http_err = (
+                    e.code, parsed if isinstance(parsed, dict) else None)
             return None
         except (urllib.error.URLError, OSError):
             self._note_transport_failure()
@@ -341,30 +370,104 @@ class RemotePeer:
     def ks_gossip(self, shard: int,
                   since: Optional[Dict[int, int]] = None,
                   trace: Optional[str] = None,
+                  epoch: Optional[int] = None,
                   ) -> Optional[Dict[str, Any]]:
-        """GET /ks/gossip?shard=i[&vv=...]: one SHARD's delta payload
-        plus its stability summary in the response BODY ({"payload",
-        "vv", "frontier"}).  Body, not header: a round pulls several
-        shards and the header slot (take_stability) holds only one
-        summary.  Built on _get, so the nemesis fault plane and the
-        circuit breaker see it like any other pull.  ``trace`` rides
+        """GET /ks/gossip?shard=i[&vv=...][&epoch=e]: one SHARD's delta
+        payload plus its stability summary in the response BODY
+        ({"payload", "vv", "frontier"}).  Body, not header: a round
+        pulls several shards and the header slot (take_stability) holds
+        only one summary.  Built on _get, so the nemesis fault plane and
+        the circuit breaker see it like any other pull.  ``trace`` rides
         the X-CRDT-Trace header so the serve event joins the puller's
-        round in assembled traces, exactly like /gossip."""
+        round in assembled traces, exactly like /gossip.
+
+        ``epoch`` is the puller's reshard epoch; a peer at a different
+        one answers 409 and this returns its fence body ``{"fenced":
+        True, "epoch": theirs, ...}`` instead of a payload — callers
+        must check ``"fenced"`` before folding."""
         path = f"/ks/gossip?shard={int(shard)}"
         if since is not None:
             vv = json.dumps({str(r): s for r, s in since.items()})
             path += "&vv=" + urllib.parse.quote(vv)
+        if epoch is not None:
+            path += f"&epoch={int(epoch)}"
         headers = {TRACE_HEADER: trace} if trace else None
-        return self._parse(self._get(path, headers=headers))
+        self._clear_http_error()
+        out = self._parse(self._get(path, headers=headers))
+        if out is not None:
+            return out
+        err = self.take_http_error()
+        if err is not None and err[0] == 409 \
+                and err[1] is not None and err[1].get("fenced"):
+            return err[1]
+        return None
 
-    def ks_compact(self, shard: int, frontier: Dict[int, int]) -> bool:
+    def ks_compact(self, shard: int, frontier: Dict[int, int],
+                   epoch: Optional[int] = None) -> Dict[str, Any]:
         """POST /ks/compact: fold ONE shard at/under ``frontier`` —
-        stability GC gone shard-local."""
-        return self._post(
-            "/ks/compact",
-            {"shard": int(shard),
-             "frontier": {str(r): s for r, s in frontier.items()}},
-        )
+        stability GC gone shard-local.  Returns ``{"ok": True}``,
+        ``{"ok": False, "fenced": True, "epoch": theirs}`` when the
+        peer's reshard epoch differs, or ``{"ok": False}`` on transport
+        failure / node down."""
+        body: Dict[str, Any] = {
+            "shard": int(shard),
+            "frontier": {str(r): s for r, s in frontier.items()},
+        }
+        if epoch is not None:
+            body["epoch"] = int(epoch)
+        got = self._post_json("/ks/compact", body)
+        if got is None:
+            return {"ok": False}
+        if got["status"] == 200:
+            return {"ok": True}
+        rb = got["body"] or {}
+        if got["status"] == 409 and rb.get("fenced"):
+            return {"ok": False, "fenced": True,
+                    "epoch": int(rb.get("epoch", -1))}
+        return {"ok": False}
+
+    def ks_migrate(self, shard: int, payload: Dict[str, Any], epoch: int,
+                   trace: Optional[str] = None) -> Dict[str, Any]:
+        """POST /ks/migrate: one reshard migration slice for destination
+        ``shard``, as an ordinary wire payload the receiver folds into
+        its migration buffer.  Returns ``{"ok": True, "folded": n}``;
+        ``{"ok": False, "fenced": True, "epoch": theirs}`` when the
+        peer is not migrating at our epoch (retry next round — it may
+        not have been told yet); ``{"ok": False, "quarantined": err}``
+        when the peer rejected the payload as corrupt (do NOT blind-
+        retry the same bytes); ``{"ok": False}`` on transport failure —
+        the breaker/backoff machinery paces the retry."""
+        body: Dict[str, Any] = {
+            "shard": int(shard), "epoch": int(epoch), "payload": payload,
+        }
+        if trace:
+            body["trace"] = trace
+        got = self._post_json("/ks/migrate", body)
+        if got is None:
+            return {"ok": False}
+        rb = got["body"] or {}
+        if got["status"] == 200:
+            return {"ok": True, "folded": int(rb.get("folded", 0))}
+        if got["status"] == 409 and rb.get("fenced"):
+            return {"ok": False, "fenced": True,
+                    "epoch": int(rb.get("epoch", -1))}
+        if got["status"] == 400:
+            return {"ok": False,
+                    "quarantined": str(rb.get("quarantined", "rejected"))}
+        return {"ok": False}
+
+    def ks_reshard_admin(self, action: str, shards: Optional[int] = None
+                         ) -> Optional[Dict[str, Any]]:
+        """POST /admin/ks_reshard: drive one node's reshard state
+        machine (action = start|cutover|abort|status).  Returns the
+        node's status dict, or None on transport failure / refusal."""
+        body: Dict[str, Any] = {"action": str(action)}
+        if shards is not None:
+            body["shards"] = int(shards)
+        got = self._post_json("/admin/ks_reshard", body)
+        if got is None or got["status"] != 200:
+            return None
+        return got["body"]
 
     def push_payload(self, payload: Dict[str, Any]) -> bool:
         """POST /push: hand the peer a gossip payload to merge NOW —
@@ -686,14 +789,7 @@ class NetworkAgent:
         # SHARD — each shard's frontier is minted and folded on its own,
         # fed from the summaries riding /ks/gossip response bodies
         self.keyspace = keyspace
-        self.ks_trackers = [] if keyspace is None else [
-            StabilityTracker(
-                shard, [p.url for p in self.peers],
-                max_staleness=self.config.stability_max_staleness_s,
-                events=node.events,
-            )
-            for shard in keyspace.shards
-        ]
+        self.ks_trackers = self._build_ks_trackers()
         self._rng = random.Random(self.config.seed if seed is None else seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -701,6 +797,28 @@ class NetworkAgent:
         # stop() on the caller's thread — lock both sides
         self._err_lock = threading.Lock()
         self.errors: List[Exception] = []
+
+    def _build_ks_trackers(self) -> List[StabilityTracker]:
+        """One stability tracker per keyspace shard, over the CURRENT
+        plane set — called at construction and again after a reshard
+        cutover swaps the planes (refresh_ks_trackers)."""
+        if self.keyspace is None:
+            return []
+        return [
+            StabilityTracker(
+                shard, [p.url for p in self.peers],
+                max_staleness=self.config.stability_max_staleness_s,
+                events=self.node.events,
+            )
+            for shard in self.keyspace.shards
+        ]
+
+    def refresh_ks_trackers(self) -> None:
+        """Reshard-cutover reshape hook: the plane set (and its count)
+        changed under us — every per-shard tracker re-binds to the new
+        planes with empty peer summaries (stale pre-cutover summaries
+        must not mint a frontier against reborn seq spaces)."""
+        self.ks_trackers = self._build_ks_trackers()
 
     def gossip_once(self) -> bool:
         """One pull round from a random peer: KV log + (when both ends
@@ -906,18 +1024,39 @@ class NetworkAgent:
         # round events below — shard gossip shows up in assembled traces
         # exactly like the host plane's pulls (ISSUE 16 satellite)
         tid = mint_trace_id(self.node.rid)
+        # the round is pinned to ONE reshard epoch: it rides every GET
+        # (?epoch=e — a peer at another epoch 409s instead of handing us
+        # a payload whose (rid, seq) identities belong to a different
+        # plane generation) and gates the merge below (a cutover racing
+        # this round flips ks.epoch; folding a pre-cutover payload into
+        # a reborn plane would mix generations)
+        e0 = ks.epoch
         if ks.mesh_active:
-            return self._ks_pull_mesh(ks, peer, tid)
+            return self._ks_pull_mesh(ks, peer, tid, e0)
         fresh_total = 0
+        trackers = self.ks_trackers  # pinned: a cutover rebuilds the list
         for i, shard in enumerate(ks.shards):
             since = shard.version_vector() \
                 if self.config.delta_gossip else None
-            body = peer.ks_gossip(i, since, trace=tid)
+            body = peer.ks_gossip(i, since, trace=tid, epoch=e0)
             if body is None:
                 self.metrics.inc("net_ks_pull_skips")
                 self.node.events.emit("ks_pull_skip", trace=tid,
                                       peer=peer.url, shard=i)
                 continue
+            if body.get("fenced"):
+                # the peer is at another epoch: every shard of this
+                # round would fence identically, so ONE loud client-
+                # side fence record covers the round (1:1 with the
+                # driver-predicted count in the reshard nemesis)
+                self.metrics.inc("net_ks_fenced")
+                self.node.events.emit(
+                    "ks_reshard_fence", role="client",
+                    surface="ks_gossip", trace=tid, peer=peer.url,
+                    epoch=e0, got=int(body.get("epoch", -1)))
+                break
+            if ks.epoch != e0:
+                break  # cutover landed mid-round: drop the stale rest
             try:
                 payload = body.get("payload")
                 with span("crdt.ks_pull", tid):
@@ -940,32 +1079,45 @@ class NetworkAgent:
                             for r, s in (body.get("frontier") or {}).items()}
             except (ValueError, TypeError):
                 continue  # summary malformed: merge stood, tracker skips
-            self.ks_trackers[i].note(peer.url, vv, frontier)
+            trackers[i].note(peer.url, vv, frontier)
         self.metrics.inc("net_ks_pulls")
         if fresh_total:
             self.metrics.inc("net_ks_fresh", fresh_total)
         return fresh_total
 
-    def _ks_pull_mesh(self, ks, peer: RemotePeer, tid: str) -> int:
+    def _ks_pull_mesh(self, ks, peer: RemotePeer, tid: str,
+                      e0: int) -> int:
         """The fused pull round: fetch every shard's delta first (the S
         HTTP GETs are unchanged), then fold ALL shards in ONE device-mesh
         step (`ShardedKeyspace.receive_all` -> `MeshPlane.converge`).
         Same quarantine semantics as the host loop — a corrupt shard
         payload isolates that shard's lane inside the fused step while
-        the siblings still fold."""
+        the siblings still fold.  Epoch-pinned like the host loop: a
+        fenced response ends the round with one client fence record, and
+        a cutover racing the fetches drops the whole fold."""
         payloads: List[Optional[Dict[str, Any]]] = [None] * ks.n_shards
         bodies: List[Optional[dict]] = [None] * ks.n_shards
+        trackers = self.ks_trackers  # pinned: a cutover rebuilds the list
         for i, shard in enumerate(ks.shards):
             since = shard.version_vector() \
                 if self.config.delta_gossip else None
-            body = peer.ks_gossip(i, since, trace=tid)
+            body = peer.ks_gossip(i, since, trace=tid, epoch=e0)
             if body is None:
                 self.metrics.inc("net_ks_pull_skips")
                 self.node.events.emit("ks_pull_skip", trace=tid,
                                       peer=peer.url, shard=i)
                 continue
+            if body.get("fenced"):
+                self.metrics.inc("net_ks_fenced")
+                self.node.events.emit(
+                    "ks_reshard_fence", role="client",
+                    surface="ks_gossip", trace=tid, peer=peer.url,
+                    epoch=e0, got=int(body.get("epoch", -1)))
+                return 0
             bodies[i] = body
             payloads[i] = body.get("payload")
+        if ks.epoch != e0:
+            return 0  # cutover landed mid-round: drop the stale fold
         with span("crdt.ks_pull_mesh", tid):
             results = ks.receive_all(payloads, quarantine=True)
         fresh_total = 0
@@ -989,11 +1141,55 @@ class NetworkAgent:
                             for r, s in (body.get("frontier") or {}).items()}
             except (ValueError, TypeError):
                 continue  # summary malformed: merge stood, tracker skips
-            self.ks_trackers[i].note(peer.url, vv, frontier)
+            trackers[i].note(peer.url, vv, frontier)
         self.metrics.inc("net_ks_pulls")
         if fresh_total:
             self.metrics.inc("net_ks_fresh", fresh_total)
         return fresh_total
+
+    def ks_reshard_stream(self) -> Dict[str, int]:
+        """One MIGRATE-window streaming round: every moved key's current
+        evidence, sliced per destination shard, POSTed to every
+        reachable peer (``/ks/migrate``).  The receiver's fold is a
+        max-(ts, rid, seq) per key, so re-sending a slice is idempotent
+        — this round simply re-streams everything still moved, and the
+        window converges as long as one round lands after the last
+        pre-cutover write.  Peers inside a backoff window are skipped
+        (the breaker paces the retry); fenced peers (not migrating yet,
+        or already cut over) are counted and retried next round; a
+        quarantine verdict is counted loudly and NOT blind-retried this
+        round.  Returns {sent, ok, fenced, quarantined, failed}."""
+        ks = self.keyspace
+        stats = {"sent": 0, "ok": 0, "fenced": 0, "quarantined": 0,
+                 "failed": 0}
+        if ks is None or not self.node.alive:
+            return stats
+        slices = ks.reshard.migration_slices()
+        if not slices:
+            return stats
+        e0 = ks.epoch
+        tid = mint_trace_id(self.node.rid)
+        for peer in self.peers:
+            if peer.backed_off():
+                continue
+            for dst, payload in slices:
+                stats["sent"] += 1
+                out = peer.ks_migrate(dst, payload, e0, trace=tid)
+                if out.get("ok"):
+                    stats["ok"] += 1
+                elif out.get("fenced"):
+                    stats["fenced"] += 1
+                    self.metrics.inc("net_ks_fenced")
+                    self.node.events.emit(
+                        "ks_reshard_fence", role="client",
+                        surface="ks_migrate", trace=tid, peer=peer.url,
+                        epoch=e0, got=int(out.get("epoch", -1)))
+                elif "quarantined" in out:
+                    stats["quarantined"] += 1
+                else:
+                    stats["failed"] += 1
+        self.node.events.emit("ks_reshard_stream", trace=tid, **stats)
+        return stats
 
     def ks_gc_once(self, step: Optional[int] = None) -> Dict[int, dict]:
         """One SHARD-LOCAL stability-GC round (coordinator only): each
@@ -1009,17 +1205,27 @@ class NetworkAgent:
         # its folds cause) shows up as one joined group in assembled
         # traces instead of anonymous leftovers
         tid = mint_trace_id(self.node.rid)
+        e0 = ks.epoch
         out: Dict[int, dict] = {}
-        for i, tracker in enumerate(self.ks_trackers):
+        for i, tracker in enumerate(list(self.ks_trackers)):
             frontier = tracker.mint(step=step)
             if not frontier:
                 self.metrics.inc("ks_gc_skipped")
                 continue
+            if ks.epoch != e0:
+                break  # cutover landed mid-round: stale frontiers die
             with span("crdt.ks_gc", tid):
                 ks.compact_shard(i, frontier)
             for p in self.peers:
-                if not p.backed_off():
-                    p.ks_compact(i, frontier)
+                if p.backed_off():
+                    continue
+                got = p.ks_compact(i, frontier, epoch=e0)
+                if got.get("fenced"):
+                    self.metrics.inc("net_ks_fenced")
+                    self.node.events.emit(
+                        "ks_reshard_fence", role="client",
+                        surface="ks_compact", trace=tid, peer=p.url,
+                        epoch=e0, got=int(got.get("epoch", -1)))
             out[i] = frontier
         if out:
             self.metrics.inc("ks_gc_rounds")
@@ -1464,6 +1670,10 @@ class NodeHost:
             map_node=self.map_node, composite_node=self.composite_node,
             keyspace=self.keyspace,
         )
+        if self.keyspace is not None:
+            # reshard reshape hook: a cutover swaps the plane set and
+            # everything host-side that cached it must re-bind
+            self.keyspace.on_reshape(self._on_ks_reshape)
         # strong read/CAS coordinator (crdt_tpu.consistency): reads
         # agent.peers LIVE so a harness that swaps the peer list for
         # FaultyTransports after boot keeps the plane inside the fault
@@ -1520,12 +1730,31 @@ class NodeHost:
             self._ks_birth_ledgers = list(ks_ledgers)
         ks = getattr(self, "keyspace", None)
         if ks is not None:
-            ledgers = self._ks_birth_ledgers
-            for i, shard in enumerate(ks.shards):
-                shard.recorder.install(
-                    ledger=ledgers[i]
-                    if ledgers and i < len(ledgers) else None,
-                    step_clock=step_clock)
+            self._install_ks_recorders(step_clock)
+
+    def _install_ks_recorders(self, step_clock) -> None:
+        """Wire the per-shard ledgers + step clock into the CURRENT
+        shard set's flight recorders — split out of
+        install_flight_recorder because a reshard cutover rebirths the
+        planes and the reshape hook must re-run exactly this part
+        (fresh shards carry unbound recorders) without touching the
+        host recorder's ledger."""
+        ledgers = self._ks_birth_ledgers
+        for i, shard in enumerate(self.keyspace.shards):
+            shard.recorder.install(
+                ledger=ledgers[i]
+                if ledgers and i < len(ledgers) else None,
+                step_clock=step_clock)
+
+    def _on_ks_reshape(self) -> None:
+        """Reshard-cutover reshape hook (runs with the door's admission
+        lock held, right after the plane swap): everything host-side
+        that cached the old plane set re-binds — the per-shard stability
+        trackers and the shard flight recorders.  The tenant door's lane
+        set was already rebuilt by the coordinator itself (it holds the
+        admission lock), and the mesh plane was reset inside the swap."""
+        self.agent.refresh_ks_trackers()
+        self._install_ks_recorders(self._step_clock)
 
     def start_server(self) -> None:
         """Serve the HTTP surface only (no background gossip) — for drivers
@@ -1692,3 +1921,40 @@ class NodeHost:
         """One shard-local stability-GC round, now (coordinator only):
         {shard: frontier} for the shards whose frontier was provable."""
         return self.agent.ks_gc_once()
+
+    def admin_ks_reshard(self, body: dict) -> dict:
+        """Drive this node's reshard state machine (POST
+        /admin/ks_reshard).  Actions:
+
+          {"action": "start", "shards": S'}  — PREPARE + open the
+              MIGRATE window toward S' shards (idempotent for the same
+              target; a node already AT S' with an idle machine answers
+              its status instead of failing, so a resumed driver can
+              re-send)
+          {"action": "stream"}   — one migration streaming round to
+              every reachable peer (returns the round's stats)
+          {"action": "cutover"}  — epoch bump + plane rebirth at S'
+          {"action": "abort"}    — roll back to the old epoch
+          {"action": "status"}   — the machine's current state
+
+        Raises ValueError on an invalid action/transition (the HTTP
+        shim answers 400 with the message)."""
+        if self.keyspace is None:
+            raise ValueError("no keyspace tier on this node")
+        action = str(body.get("action", "status"))
+        if action == "start":
+            target = int(body.get("shards", 0))
+            if self.keyspace.n_shards == target \
+                    and self.keyspace.reshard.phase == "idle":
+                return self.keyspace.reshard.status()  # already there
+            return self.keyspace.reshard.start(target)
+        if action == "stream":
+            return dict(self.agent.ks_reshard_stream())
+        if action == "cutover":
+            return self.keyspace.reshard.cutover()
+        if action == "abort":
+            return self.keyspace.reshard.abort(
+                str(body.get("reason", "admin")))
+        if action == "status":
+            return self.keyspace.reshard.status()
+        raise ValueError(f"unknown ks_reshard action {action!r}")

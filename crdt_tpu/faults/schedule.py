@@ -59,6 +59,23 @@ class FaultRule:
         )
 
 
+def reshard_window_rules(start: int, end: int) -> List["FaultRule"]:
+    """Fault windows aimed at the reshard migration stream (op
+    "ks_migrate", the POST /ks/migrate leg): a CORRUPT window in the
+    first half — mangled wire key, receiver must quarantine the slice
+    whole — and a DROP window (partitioned migration stream; resume
+    re-streams idempotently) in the second.  The sub-windows are
+    DISJOINT so every recorded corrupt reconciles 1:1 with a receiver
+    ks_reshard_quarantine event — a drop co-firing on the same decision
+    would swallow the corrupted message before it arrived."""
+    mid = max(start + 1, (start + end) // 2)
+    return [
+        FaultRule("corrupt", op="ks_migrate", start=start, end=mid,
+                  p=0.6),
+        FaultRule("drop", op="ks_migrate", start=mid, end=end, p=0.5),
+    ]
+
+
 @dataclasses.dataclass(frozen=True)
 class SkewEvent:
     """At ``step``, shift node ``node``'s clock epoch by ``skew_ms`` —

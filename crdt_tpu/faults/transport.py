@@ -21,10 +21,11 @@ Fault semantics per kind (see also crdt_tpu/faults/README.md):
               "no payload", never "some payload".
 * corrupt   — bytes arrive altered.  Non-gossip bodies get a flipped
               first byte (breaks the JSON object → parse-skip); gossip
-              payloads get a mangled WIRE KEY / poisoned section instead
-              — still valid JSON, so it reaches the node and must be
-              QUARANTINED there (payload_quarantine event), which is the
-              hardening this fault exists to exercise.
+              and reshard-migration payloads get a mangled WIRE KEY /
+              poisoned section instead — still valid JSON, so it reaches
+              the node and must be QUARANTINED there (payload_quarantine
+              / ks_reshard_quarantine event), which is the hardening this
+              fault exists to exercise.
 * duplicate — the payload is delivered now AND queued for redelivery on
               a later pull (same bytes twice; join idempotence makes the
               second a no-op).
@@ -211,6 +212,25 @@ class FaultyTransport(RemotePeer):
             with self._stale_lock:
                 self._stale.append(copy.deepcopy(payload))
         return payload
+
+    # ---- payload-level faults on the reshard migration stream ----
+
+    def ks_migrate(self, shard: int, payload: Dict[str, Any], epoch: int,
+                   trace: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        # drop/delay ride the generic _post_json override (op is
+        # "ks_migrate" via _op_of); only CORRUPT needs payload-level
+        # handling — a mangled WIRE KEY keeps the body valid JSON so it
+        # reaches receive_migration and must be quarantined WHOLE there
+        # (all-or-nothing: no row subset folded).  When drop co-fires on
+        # the same decision, the message never arrives: record nothing,
+        # so corrupt records reconcile 1:1 with receiver quarantines.
+        faults = self.plane.decide(self.src, self.dst, "ks_migrate")
+        if "corrupt" in faults and "drop" not in faults:
+            self.plane.record("corrupt", src=self.src, dst=self.dst,
+                              op="ks_migrate")
+            payload = dict(payload)
+            payload["nemesis:corrupt:key"] = {"Key": "x", "Value": "y"}
+        return super().ks_migrate(shard, payload, epoch, trace=trace)
 
     def pending_redelivery(self) -> int:
         """Held payloads not yet redelivered (drained by heal-phase pulls;

@@ -62,14 +62,12 @@ class KeyspaceFrontDoor:
             else ks.shards[0].metrics
         self.events = events
         self.node = str(node)
+        # lane construction knobs kept: reshard cutover rebuilds the
+        # lane set at the new shard count with identical wiring
+        self._max_batch = max_batch
+        self._flush_deadline_s = flush_deadline_s
         # one lane per shard; lane items are (ts, {qkey: value}, tenant)
-        self.lanes: List[AdmissionQueue] = [
-            AdmissionQueue(
-                f"ks{i}", self._make_flush(i), max_batch=max_batch,
-                flush_deadline_s=flush_deadline_s, policy=self.policy,
-                metrics=self.metrics, events=events, node=self.node)
-            for i in range(ks.n_shards)
-        ]
+        self.lanes: List[AdmissionQueue] = self._build_lanes()
         # serializes ADMISSIONS across lanes (whole-page atomicity);
         # drains never take it — they only shrink lane depths
         self._adm = threading.Lock()
@@ -82,6 +80,29 @@ class KeyspaceFrontDoor:
         # as IngestFrontDoor.admit_page
         self._page_watermark: Dict[int, int] = {}
         self._wm_lock = threading.Lock()
+        # reshard cutover gates admissions through self._adm and drains/
+        # rebuilds the lanes while holding it
+        ks.attach_door(self)
+
+    def _build_lanes(self) -> List[AdmissionQueue]:
+        return [
+            AdmissionQueue(
+                f"ks{i}", self._make_flush(i), max_batch=self._max_batch,
+                flush_deadline_s=self._flush_deadline_s,
+                policy=self.policy, metrics=self.metrics,
+                events=self.events, node=self.node)
+            for i in range(self.ks.n_shards)
+        ]
+
+    def rebuild_lanes(self) -> None:
+        """Swap in a fresh lane set for the post-cutover shard count.
+        CALLER HOLDS ``self._adm`` (the reshard coordinator, which also
+        drained every lane first) — no admission can race the swap, and
+        drains never touch ``self.lanes`` except through a claim they
+        already hold.  The flush closures capture shard INDICES and read
+        ``self.ks.shards[i]`` live, so the new lanes mint into the new
+        planes with no further rewiring."""
+        self.lanes = self._build_lanes()
 
     # ---- drain side ----
 

@@ -76,29 +76,20 @@ class ShardedKeyspace:
         self.n_shards = n_shards
         self.router = RendezvousRouter(
             [f"shard-{i}" for i in range(n_shards)])
+        # construction args kept: online resharding (keyspace/reshard)
+        # rebirths the plane set at a new shard count and must build the
+        # replacement shards with identical wiring
+        self.capacity = int(capacity)
+        self.events = events
+        self.clock = clock
+        self._metrics_arg = metrics
         # shards share the host's metrics/events sinks: merge-dispatch
         # counters aggregate (what the bench reads) and shard events land
         # in the same black box
         self.shards: List[ReplicaNode] = [
-            ReplicaNode(rid=rid, capacity=capacity, metrics=metrics,
-                        clock=clock, events=events)
-            for _ in range(n_shards)
+            self._make_shard(i) for i in range(n_shards)
         ]
-        # per-shard flight-recorder identity: shards share the host's rid
-        # AND its seq-from-0 space, so their op_birth/op_visible records
-        # (and propagation series) must carry the shard label to stay
-        # disjoint from the host plane's and each other's.  tenant_of
-        # turns each merged op's qualified key into a tenant label — the
-        # ISSUE-16 per-{tenant,shard} propagation view, derived at merge
-        # time with zero wire change.
-        for i, shard in enumerate(self.shards):
-            shard.recorder.bind(extra={"shard": str(i)},
-                                tenant_of=tenant_of_cmd)
-            # per-shard merge attribution: merge_dispatches{shard=i} /
-            # union_path{shard=i} tick once per folded LANE on both the
-            # host and mesh paths, so the per-shard view survives the
-            # mesh plane collapsing S folds into one device dispatch
-            shard._metric_labels = {"shard": str(i)}
+        self.metrics = self.shards[0].metrics
         # level-1 interning: tenant -> small id (accounting only — ids
         # are NEVER stored or gossiped; arrival order may differ per node)
         self._tenants: Dict[str, int] = {}
@@ -107,8 +98,94 @@ class ShardedKeyspace:
         # lazily on first use so CPU-only processes that never pull
         # through the mesh path pay nothing
         self.mesh_mode = mesh
+        self._mesh_requested = mesh  # pre-resolution mode, for reshapes
         self._meshplane = None
         self._meshplane_lock = threading.Lock()
+        # online resharding: the monotone reshard epoch fencing every
+        # keyspace wire surface, the per-node state machine over it, the
+        # tenant door (registered by KeyspaceFrontDoor, drained at
+        # cutover), and the reshape callbacks the host layers register
+        # (stability trackers, flight recorders, lane sets)
+        self.epoch = 0
+        self._door = None
+        self._reshape_cbs: List[Any] = []
+        from crdt_tpu.keyspace.reshard import ReshardCoordinator
+        self.reshard = ReshardCoordinator(self)
+
+    def _make_shard(self, i: int) -> ReplicaNode:
+        """One plane shard, fully wired: per-shard flight-recorder
+        identity (shards share the host's rid AND its seq-from-0 space,
+        so their op_birth/op_visible records and propagation series
+        carry the shard label to stay disjoint from the host plane's and
+        each other's — tenant_of turns each merged op's qualified key
+        into a tenant label) and per-shard merge attribution
+        (merge_dispatches{shard=i} / union_path{shard=i} tick once per
+        folded LANE on both the host and mesh paths, so the per-shard
+        view survives the mesh plane collapsing S folds into one device
+        dispatch).  Used at construction AND by reshard cutover/restore,
+        which rebuild the plane set at a new shard count."""
+        shard = ReplicaNode(rid=self.rid, capacity=self.capacity,
+                            metrics=self._metrics_arg, clock=self.clock,
+                            events=self.events)
+        shard.recorder.bind(extra={"shard": str(i)},
+                            tenant_of=tenant_of_cmd)
+        shard._metric_labels = {"shard": str(i)}
+        return shard
+
+    # ---- online resharding (keyspace/reshard.py drives these) ----
+
+    def attach_door(self, door) -> None:
+        """The tenant front door registers itself so cutover can gate
+        admissions and drain the lanes under the declared lock order."""
+        self._door = door
+
+    def on_reshape(self, cb) -> None:
+        """Register a callback run (admission lock held) after the plane
+        set is swapped at cutover — hosts rebuild stability trackers,
+        re-install flight recorders, and re-point anything that cached
+        ``shards``/``n_shards``."""
+        self._reshape_cbs.append(cb)
+
+    def check_epoch(self, got, surface: str, peer: Optional[str] = None):
+        """None when ``got`` matches the live reshard epoch; else the
+        409 body naming it (see reshard.fence_body)."""
+        return self.reshard.check_epoch(got, surface, peer=peer)
+
+    def _adopt_planes(self, router: RendezvousRouter,
+                      shards: List[ReplicaNode], epoch: int) -> None:
+        """Atomic swap at cutover: router + plane set + shard count +
+        epoch move together (callers hold the coordinator lock and the
+        door's admission lock).  The mesh plane resets to the REQUESTED
+        mode — auto may resolve differently at the new shard count."""
+        self.router = router
+        self.shards = shards
+        self.n_shards = len(shards)
+        self.epoch = int(epoch)
+        with self._meshplane_lock:
+            self.mesh_mode = self._mesh_requested
+            self._meshplane = None
+
+    def reshape_for_restore(self, n_shards: int, epoch: int) -> None:
+        """Snapshot restore found a ledger at a different shard count:
+        rebuild empty planes at that count BEFORE the per-shard files
+        load.  No reshape callbacks — restore runs before the host
+        builds doors/agents (NodeHost restores first, wires after)."""
+        n_shards = int(n_shards)
+        if n_shards < 1:
+            raise ValueError(
+                f"reshard ledger names invalid shard count {n_shards}")
+        self._adopt_planes(
+            RendezvousRouter([f"shard-{i}" for i in range(n_shards)]),
+            [self._make_shard(i) for i in range(n_shards)], epoch)
+
+    def reshard_ledger(self) -> Dict[str, Any]:
+        """The crash-recovery ledger checkpointed as ks-reshard.json."""
+        return self.reshard.ledger()
+
+    def restore_reshard(self, snap: Dict[str, Any]) -> None:
+        """Resume (or settle) the reshard state machine from a restored
+        ledger — after the shard files have loaded."""
+        self.reshard.restore_ledger(snap)
 
     # ---- device-mesh plane ----
 

@@ -158,7 +158,17 @@ def sample_keyspace(registry, node_label: str, keyspace,
     in the shard's admission lane) shows which shard is hot RIGHT NOW;
     per-tenant ``keyspace_tenant_depth`` shows who is filling it.  The
     companion ``crdt_keyspace_tenant_ops_total`` counter (ops admitted
-    per tenant) is inc'd at drain time by the keyspace door."""
+    per tenant) is inc'd at drain time by the keyspace door.
+    ``ks_reshard_state``/``ks_reshard_epoch`` track the online-reshard
+    lifecycle (keyspace/reshard.py)."""
+    # reshard lifecycle: phase gauge (0 idle / 1 migrate, the mapping in
+    # reshard.PHASE_GAUGE) plus the monotone epoch every wire surface is
+    # fenced on — renders as crdt_ks_reshard_state / crdt_ks_reshard_epoch
+    registry.set_gauge("ks_reshard_state",
+                       float(keyspace.reshard.phase_gauge()),
+                       node=node_label)
+    registry.set_gauge("ks_reshard_epoch", float(keyspace.epoch),
+                       node=node_label)
     for i, stat in enumerate(keyspace.shard_stats()):
         registry.set_gauge("keyspace_shard_ops", float(stat["ops"]),
                            shard=str(i), node=node_label)

@@ -93,6 +93,11 @@ class FlightRecorder:
         self.node_label = str(rid)
         self.registry = registry
         self.events = events
+        # muted: checkpoint restore replays durable LOCAL state through
+        # the same receive() path live gossip uses — those merges are
+        # recovery, not propagation, and counting them would double every
+        # pre-crash observation (the events already sit in the black box)
+        self.muted = False
         self.ledger: Optional[BirthLedger] = None
         self.step_clock: Optional[Callable[[], int]] = None
         # tier labels (keyspace shards bind {"shard": "i"}): stamped onto
@@ -108,7 +113,8 @@ class FlightRecorder:
 
     @property
     def enabled(self) -> bool:
-        return bool(getattr(self.registry, "enabled", False))
+        return (not self.muted
+                and bool(getattr(self.registry, "enabled", False)))
 
     def bind(self, extra: Optional[Dict[str, str]] = None,
              tenant_of: Optional[

@@ -109,6 +109,12 @@ def save_node(path: str, node, set_node=None, seq_node=None,
                 "epoch_ms": shard.clock.epoch_ms,
                 "payload": payload or {},
             }))
+        # the reshard crash-recovery ledger: {epoch, phase, target,
+        # n_shards}.  Manifest-covered like every other section, so a
+        # node rebooting mid-MIGRATE resumes (or a post-cutover snapshot
+        # reshapes to S') deterministically at restore
+        (p / "ks-reshard.json").write_text(
+            json.dumps(keyspace.reshard_ledger()))
     if leases is not None:
         (p / "leases.json").write_text(json.dumps({
             "fences": {str(s): f
@@ -192,6 +198,24 @@ def restore_node(path: str, node, allow_rid_change: bool = False,
         composite_node.from_snapshot(
             json.loads((p / "composite.json").read_text()))
     if keyspace is not None:
+        # reshard ledger FIRST: a snapshot taken after a cutover (or one
+        # predating this node's shard-count config) names its own shard
+        # count, and the plane set must be reshaped to it BEFORE the
+        # per-shard files load — otherwise shard i's ops land in the
+        # wrong plane.  A malformed ledger raises → load_latest_node
+        # quarantines the generation, the standard posture.
+        rsf = p / "ks-reshard.json"
+        rs_snap = None
+        if rsf.exists():
+            rs_snap = json.loads(rsf.read_text())
+            if not isinstance(rs_snap, dict):
+                raise ValueError("ks-reshard.json: ledger must be a dict")
+            n = int(rs_snap.get("n_shards", keyspace.n_shards))
+            epoch = int(rs_snap.get("epoch", 0))
+            if n != keyspace.n_shards:
+                keyspace.reshape_for_restore(n, epoch)
+            else:
+                keyspace.epoch = epoch
         for i, shard in enumerate(keyspace.shards):
             f = p / f"ks-shard-{i}.json"
             if not f.exists():
@@ -204,8 +228,16 @@ def restore_node(path: str, node, allow_rid_change: bool = False,
                     f"got {type(payload).__name__}")
             # receive() validates like a gossip body — a corrupt shard
             # section raises here and load_latest_node quarantines the
-            # whole generation, exactly the composite's posture
-            shard.receive(payload)
+            # whole generation, exactly the composite's posture.  The
+            # flight recorder is MUTED for the replay: restoring durable
+            # local state is recovery, not propagation — the pre-crash
+            # incarnation already observed (and black-boxed) these ops,
+            # so re-counting them would break exactly-once provenance
+            shard.recorder.muted = True
+            try:
+                shard.receive(payload)
+            finally:
+                shard.recorder.muted = False
             if int(snap.get("rid", -1)) == shard.rid:
                 # same incarnation: the seq counter is still ours.  A
                 # fresh-rid boot keeps its zero-based counter (the old
@@ -213,6 +245,12 @@ def restore_node(path: str, node, allow_rid_change: bool = False,
                 shard._seq.count = int(snap.get("seq", 0))
             shard.clock.epoch_ms = int(
                 snap.get("epoch_ms", shard.clock.epoch_ms))
+        if rs_snap is not None:
+            # after the planes are loaded: a MIGRATE ledger re-enters
+            # the window against the restored state (deterministic
+            # resume — the plan is a pure function of the routers;
+            # peers re-stream their slices on the next round)
+            keyspace.restore_reshard(rs_snap)
     if leases is not None and (p / "leases.json").exists():
         snap = json.loads((p / "leases.json").read_text())
         fences = snap.get("fences")

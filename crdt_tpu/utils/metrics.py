@@ -110,13 +110,19 @@ class Metrics:
         # oldest mark inside the window; fall back to the newest mark
         # before it (the count was already there when the window opened)
         base_t, base_v = max(self._t0, cutoff), 0.0
+        if cutoff <= self._t0:
+            # the window covers the whole lifetime: the count at window
+            # open is exactly 0, so this IS the lifetime rate — never
+            # rebase onto the first mark (that would drop its events AND
+            # shrink the denominator by the construction-to-first-inc gap)
+            return cur / max(now - self._t0, 1e-9)
         older = [m for m in marks if m[0] <= cutoff]
         inside = [m for m in marks if m[0] > cutoff]
         if older:
             base_v = older[-1][1]
         elif inside:
             base_t, base_v = inside[0]
-        elif self._t0 <= cutoff:
+        else:
             base_v = cur  # no activity recorded in the window at all
         return max(cur - base_v, 0.0) / max(now - base_t, 1e-9)
 

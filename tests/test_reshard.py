@@ -1,0 +1,352 @@
+"""Online keyspace resharding (crdt_tpu/keyspace/reshard.py): the
+epoch-fenced live migration state machine.
+
+What is pinned here, failure-mode first:
+
+* crash mid-MIGRATE — a node checkpointed inside the window reboots,
+  the restored ledger re-enters MIGRATE deterministically, and the
+  resumed cutover lands the same tenant state the live one would have;
+* ABORT — rolls back bit-identical (epoch, shard count, every shard's
+  full wire dump) because nothing mutates before CUTOVER;
+* stale-epoch fencing — every fenced wire surface (/ks/gossip,
+  /ks/compact, /ks/migrate, the stamped /ingest/page admit) answers
+  409 naming the CURRENT epoch, 1:1 with serve-side fence provenance;
+* corrupt migration slices — quarantined whole, loudly, without
+  wedging the window (the next clean slice folds, cutover proceeds);
+* lock discipline — every refusal path leaves the coordinator lock,
+  the door's admission lock, and the shard locks free (the CRDT210/212
+  shapes: a leaked lock here wedges admissions forever).
+
+The nemesis soak (--reshard) drives the same machine under a full
+fault schedule; these tests are the deterministic, seed-free floor.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from crdt_tpu.api.net import NodeHost, RemotePeer
+from crdt_tpu.keyspace import (ShardedKeyspace, TENANT_HEADER, qualify,
+                               split_qualified)
+from crdt_tpu.keyspace.reshard import PHASE_IDLE, PHASE_MIGRATE
+from crdt_tpu.utils.config import ClusterConfig
+
+KS_EPOCH_HEADER = "X-CRDT-KS-Epoch"
+
+CFG = dict(keyspace_shards=2, keyspace_capacity=256)
+
+
+def _serve(*hosts):
+    for h in hosts:
+        t = threading.Thread(target=h._server.serve_forever, daemon=True)
+        t.start()
+
+
+def _shutdown(*hosts):
+    for h in hosts:
+        h._server.shutdown()
+        h._server.server_close()
+
+
+def _post(url, body, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=5) as res:
+        raw = res.read()
+        try:
+            return res.status, json.loads(raw or b"null")
+        except json.JSONDecodeError:  # plain-text 200s ("OK")
+            return res.status, raw.decode()
+
+
+def _write(ks: ShardedKeyspace, tenant: str, key: str, value: str):
+    qkey = qualify(tenant, key)
+    assert ks.shards[ks.shard_of(tenant, key)].add_command({qkey: value})
+
+
+def _ks_dump(ks: ShardedKeyspace):
+    """The bit-identity witness: epoch + shard count + every shard's
+    FULL wire dump (raw ops and folded summaries alike ride it)."""
+    return (ks.epoch, ks.n_shards,
+            [s.gossip_payload(since=None) for s in ks.shards])
+
+
+# ---- ABORT: bit-identical rollback ----
+
+def test_abort_rolls_back_bit_identical():
+    ks = ShardedKeyspace(rid=0, n_shards=2, capacity=256)
+    for i in range(24):
+        _write(ks, "t-acme", f"k{i:03d}", f"v{i}")
+    before = _ks_dump(ks)
+    out = ks.reshard.start(4)
+    assert out["phase"] == PHASE_MIGRATE and out["moved"] > 0
+    # a peer's slice folds into the buffer — still pre-cutover, so the
+    # abort must discard it along with the plan
+    moved = [q for q in ks.state() if ks.reshard.moved_to(q) is not None]
+    dst = ks.reshard.moved_to(moved[0])
+    fold = ks.reshard.receive_migration(
+        dst, {"1000:9:0": {moved[0]: "peer-value"}})
+    assert fold["ok"] and fold["folded"] == 1
+    assert ks.reshard.abort("test")["phase"] == PHASE_IDLE
+    assert _ks_dump(ks) == before, "abort must be bit-identical"
+    # idempotent: aborting an idle machine is a no-op status answer
+    assert ks.reshard.abort("again")["phase"] == PHASE_IDLE
+    # and the machine is reusable: a fresh window opens cleanly
+    assert ks.reshard.start(4)["phase"] == PHASE_MIGRATE
+
+
+# ---- crash mid-MIGRATE: ledger resume ----
+
+def test_crash_mid_migrate_resumes_and_cuts_over(tmp_path):
+    d = str(tmp_path / "ckpt")
+    cfg = ClusterConfig(**CFG)
+    a = NodeHost(rid=0, peers=[], config=cfg, checkpoint_dir=d)
+    for i in range(20):
+        _write(a.keyspace, "t-acme", f"k{i:03d}", f"v{i}")
+    expect = a.keyspace.tenant_state("t-acme")
+    assert a.admin_ks_reshard({"action": "start", "shards": 4})[
+        "phase"] == PHASE_MIGRATE
+    assert a.checkpoint_now() is not None  # the ledger rides the manifest
+    a._server.server_close()  # SIGKILL analogue: no cutover ever ran
+
+    b = NodeHost(rid=0, peers=[], config=cfg, checkpoint_dir=d)
+    try:
+        assert b.restored
+        # the restored ledger re-entered MIGRATE deterministically
+        st = b.keyspace.reshard.status()
+        assert st["phase"] == PHASE_MIGRATE and st["target"] == 4
+        assert b.keyspace.epoch == 0 and b.keyspace.n_shards == 2
+        # ... and the resumed window cuts over to the same tenant state
+        out = b.admin_ks_reshard({"action": "cutover"})
+        assert out["epoch"] == 1 and out["n_shards"] == 4
+        assert b.keyspace.tenant_state("t-acme") == expect
+        # a settled post-cutover snapshot restores straight to S'=4 idle
+        assert b.checkpoint_now() is not None
+        b._server.server_close()
+        c = NodeHost(rid=0, peers=[], config=cfg, checkpoint_dir=d)
+        try:
+            assert c.keyspace.n_shards == 4 and c.keyspace.epoch == 1
+            assert c.keyspace.reshard.status()["phase"] == PHASE_IDLE
+            assert c.keyspace.tenant_state("t-acme") == expect
+        finally:
+            c._server.server_close()
+    except Exception:
+        b._server.server_close()
+        raise
+
+
+# ---- stale-epoch 409 on every fenced surface ----
+
+def test_stale_epoch_409_on_every_fenced_surface():
+    from crdt_tpu.ingest import PageBuilder
+
+    cfg = ClusterConfig(**CFG)
+    a = NodeHost(rid=0, peers=[], config=cfg)
+    _serve(a)
+    try:
+        _write(a.keyspace, "t-acme", "k0", "v0")
+        a.admin_ks_reshard({"action": "start", "shards": 4})
+        out = a.admin_ks_reshard({"action": "cutover"})
+        assert out["epoch"] == 1 and out["n_shards"] == 4
+        fences0 = a.keyspace.reshard.fences
+
+        def expect_409(fn, surface):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                fn()
+            assert ei.value.code == 409
+            body = json.loads(ei.value.read())
+            assert body["fenced"] is True and body["epoch"] == 1
+            assert body["surface"] == surface
+            return body
+
+        # GET /ks/gossip — explicit stale epoch AND the pre-reshard
+        # no-epoch client (treated as epoch 0: fenced after cutover)
+        expect_409(lambda: urllib.request.urlopen(
+            a.url + "/ks/gossip?shard=0&epoch=0", timeout=5), "ks_gossip")
+        got = expect_409(lambda: urllib.request.urlopen(
+            a.url + "/ks/gossip?shard=0", timeout=5), "ks_gossip")
+        assert got["got"] == 0
+        # POST /ks/compact — a frontier minted against the old planes
+        expect_409(lambda: _post(a.url + "/ks/compact",
+                                 {"shard": 0, "frontier": {},
+                                  "epoch": 0}), "ks_compact")
+        # POST /ks/migrate — a stale-epoch migration slice
+        expect_409(lambda: _post(a.url + "/ks/migrate",
+                                 {"shard": 0, "epoch": 0, "payload": {}}),
+                   "ks_migrate")
+        # POST /ingest/page — a stamped writer behind the map
+        pager = PageBuilder(origin=7, page_size=1 << 16)
+        pager.add("k1", "v1")
+        raw = pager.flush()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req = urllib.request.Request(
+                a.url + "/ingest/page", data=raw, method="POST")
+            req.add_header(TENANT_HEADER, "t-acme")
+            req.add_header(KS_EPOCH_HEADER, "0")
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 409
+        body = json.loads(ei.value.read())
+        assert body["fenced"] is True and body["epoch"] == 1
+        assert body["surface"] == "ingest_page"
+        # every refusal black-boxed: serve-side fence count is 1:1
+        assert a.keyspace.reshard.fences - fences0 == 5
+        # the CURRENT epoch passes every surface it fenced
+        assert urllib.request.urlopen(
+            a.url + "/ks/gossip?shard=0&epoch=1", timeout=5).status == 200
+        assert _post(a.url + "/ks/compact",
+                     {"shard": 0, "frontier": {}, "epoch": 1})[0] == 200
+        req = urllib.request.Request(
+            a.url + "/ingest/page", data=raw, method="POST")
+        req.add_header(TENANT_HEADER, "t-acme")
+        req.add_header(KS_EPOCH_HEADER, "1")
+        assert urllib.request.urlopen(req, timeout=5).status == 200
+    finally:
+        _shutdown(a)
+
+
+# ---- corrupt migration slices: quarantined, never wedged ----
+
+def test_corrupt_migration_slice_quarantined_without_wedging():
+    ks = ShardedKeyspace(rid=0, n_shards=2, capacity=256)
+    for i in range(16):
+        _write(ks, "t-acme", f"k{i:03d}", f"v{i}")
+    ks.reshard.start(4)
+    moved = [q for q in ks.state() if ks.reshard.moved_to(q) is not None]
+    dst = ks.reshard.moved_to(moved[0])
+    q0 = ks.reshard.quarantines
+    # malformed wire key (still valid JSON — the corrupt-fault shape)
+    out = ks.reshard.receive_migration(
+        dst, {"nemesis:corrupt:key": {moved[0]: "x"}})
+    assert out["ok"] is False and "quarantined" in out
+    # non-dict command
+    out = ks.reshard.receive_migration(dst, {"1000:1:0": "not-a-dict"})
+    assert out["ok"] is False and "quarantined" in out
+    # a row routed at the WRONG destination: all-or-nothing, the whole
+    # slice is refused even though other rows may be clean
+    kept = next(q for q in ks.state()
+                if ks.reshard.moved_to(q) is None)
+    out = ks.reshard.receive_migration(
+        dst, {"1000:1:0": {moved[0]: "a", kept: "b"}})
+    assert out["ok"] is False and "quarantined" in out
+    assert ks.reshard.quarantines - q0 == 3
+    # the window is NOT wedged: a clean slice folds, cutover proceeds.
+    # wire keys carry ABSOLUTE ms — year-2100 beats any local mint, so
+    # the buffered peer candidate must win the LWW fold
+    out = ks.reshard.receive_migration(
+        dst, {"4102444800000:9:0": {moved[0]: "peer-wins"}})
+    assert out["ok"] and out["folded"] == 1
+    cut = ks.reshard.cutover()
+    assert cut["epoch"] == 1 and cut["n_shards"] == 4
+    tenant, key = split_qualified(moved[0])
+    assert ks.get(tenant, key) == "peer-wins"
+
+
+def test_receive_migration_outside_window_refuses():
+    ks = ShardedKeyspace(rid=0, n_shards=2, capacity=64)
+    out = ks.reshard.receive_migration(0, {"1:1:0": {"t:k": "v"}})
+    assert out == {"ok": False, "reason": "not-migrating", "epoch": 0}
+    assert ks.reshard.quarantines == 0  # a refusal, not a quarantine
+
+
+# ---- lock discipline on the failure paths (CRDT210/212 shapes) ----
+
+def _acquirable(lock, timeout=2.0) -> bool:
+    """Prove the lock is FREE from another thread (an RLock re-acquired
+    on the owning thread proves nothing)."""
+    got = []
+
+    def probe():
+        ok = lock.acquire(timeout=timeout)
+        if ok:
+            lock.release()
+        got.append(ok)
+
+    t = threading.Thread(target=probe)
+    t.start()
+    t.join(timeout + 1)
+    return bool(got and got[0])
+
+
+def test_failure_paths_release_every_lock():
+    cfg = ClusterConfig(**CFG)
+    a = NodeHost(rid=0, peers=[], config=cfg)
+    try:
+        ks = a.keyspace
+        for i in range(8):
+            _write(ks, "t-acme", f"k{i}", "v")
+        door = ks._door
+        # refused start (already at target count)
+        with pytest.raises(ValueError):
+            ks.reshard.start(2)
+        # cutover without a window
+        with pytest.raises(ValueError):
+            ks.reshard.cutover()
+        # conflicting second target mid-window
+        ks.reshard.start(4)
+        with pytest.raises(ValueError):
+            ks.reshard.start(3)
+        # quarantined slice inside the window
+        out = ks.reshard.receive_migration(99, {"1:1:0": {"t:k": "v"}})
+        assert "quarantined" in out
+        assert _acquirable(ks.reshard._phase_lock), "coordinator lock leaked"
+        assert _acquirable(door._adm), "door admission lock leaked"
+        for shard in ks.shards:
+            assert _acquirable(shard._lock), "shard lock leaked"
+        # and the happy path leaves them free too (cutover touches all)
+        cut = ks.reshard.cutover()
+        assert cut["epoch"] == 1
+        assert _acquirable(ks.reshard._phase_lock)
+        assert _acquirable(door._adm)
+        for shard in ks.shards:
+            assert _acquirable(shard._lock)
+        # admissions still flow post-cutover: nothing wedged
+        assert door.admit_kv("t-acme", "post", "cut") is not None
+    finally:
+        a._server.server_close()
+
+
+# ---- two-node end-to-end over real sockets ----
+
+def test_reshard_end_to_end_over_http():
+    """The whole arc on real sockets: write on A, open the window on
+    both, stream A's slices, cut both over, and assert S'=4 serves the
+    same tenant state at epoch 1 — then post-cutover anti-entropy still
+    converges fresh writes."""
+    cfg = ClusterConfig(**CFG)
+    a = NodeHost(rid=0, peers=[], config=cfg)
+    b = NodeHost(rid=1, peers=[], config=cfg)
+    _serve(a, b)
+    try:
+        a.agent.peers = [RemotePeer(b.url)]
+        b.agent.peers = [RemotePeer(a.url)]
+        for i in range(20):
+            _write(a.keyspace, "t-acme", f"k{i:03d}", f"v{i}")
+        assert b.agent.ks_pull(b.agent.peers[0]) == 20
+        expect = a.keyspace.tenant_state("t-acme")
+        # open the window everywhere, then stream (a not-yet-started
+        # receiver would 409 the slices as not-migrating)
+        for h in (a, b):
+            assert _post(h.url + "/admin/ks_reshard",
+                         {"action": "start", "shards": 4})[1][
+                "phase"] == PHASE_MIGRATE
+        stats = _post(a.url + "/admin/ks_reshard",
+                      {"action": "stream"})[1]
+        assert stats["sent"] > 0 and stats["failed"] == 0
+        assert stats["ok"] == stats["sent"]
+        for h in (a, b):
+            out = _post(h.url + "/admin/ks_reshard",
+                        {"action": "cutover"})[1]
+            assert out["epoch"] == 1 and out["n_shards"] == 4
+            assert h.keyspace.tenant_state("t-acme") == expect
+        # fresh planes, fresh writes: ordinary anti-entropy at epoch 1
+        _write(a.keyspace, "t-acme", "post-cutover", "yes")
+        assert b.agent.ks_pull(b.agent.peers[0]) > 0
+        assert b.keyspace.get("t-acme", "post-cutover") == "yes"
+    finally:
+        _shutdown(a, b)
