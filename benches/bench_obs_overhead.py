@@ -152,6 +152,97 @@ def _run_ks_block(n_rounds: int, registry) -> float:
         gc.enable()
 
 
+def _run_audit_block(n_rounds: int, audit_on: bool) -> float:
+    """Seconds for n_rounds write + pull + fold-cadence rounds with the
+    live divergence audit plane ON vs OFF — the REAL metrics registry
+    rides both arms, so this A/B isolates the digest plane itself: the
+    incremental winner-row upkeep inside every merge, the serve-side
+    ``audit_snapshot()`` that piggybacks (vv, frontier, digest) onto the
+    gossip response, and the receiving watchdog's note + frontier-
+    anchored compare + cadenced scrub.  A periodic frontier fold runs in
+    BOTH arms (that is workload, not audit — and it is what makes the
+    clamp path non-vacuous, since digests only compare at non-empty
+    frontiers)."""
+    from crdt_tpu.api.node import ReplicaNode, pull_round
+    from crdt_tpu.obs.registry import MetricsRegistry
+    from crdt_tpu.obs.trace import mint_trace_id
+    from crdt_tpu.utils.clock import HostClock
+    from crdt_tpu.utils.metrics import Metrics
+
+    clock = HostClock()
+    metrics = Metrics(registry=MetricsRegistry())
+    writer = ReplicaNode(rid=0, clock=clock, metrics=metrics)
+    puller = ReplicaNode(rid=1, clock=clock, metrics=metrics)
+    watchdog = None
+    if audit_on:
+        from crdt_tpu.obs.audit import AuditWatchdog
+
+        writer.enable_audit()
+        puller.enable_audit()
+        watchdog = AuditWatchdog(puller)
+    # warm the jit caches (and the digest lanes) outside the timed region
+    writer.add_command({"warm": "1"})
+    pull_round(puller, writer.gossip_payload, metrics, delta=True,
+               peer="0", trace=mint_trace_id(1))
+    f0 = writer.version_vector()
+    writer.compact(f0)
+    puller.compact(f0)
+    if audit_on:
+        _, frontier, dig = writer.audit_snapshot()
+        watchdog.note_host("http://writer", frontier, dig)
+    import gc
+
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        for i in range(n_rounds):
+            writer.add_command({f"k{i % 8}": str(i)})
+            pull_round(
+                puller, writer.gossip_payload, metrics, delta=True,
+                peer="0", trace=mint_trace_id(1),
+            )
+            if i % 16 == 15:  # the soak's GC cadence, in both arms
+                f = writer.version_vector()
+                writer.compact(f)
+                puller.compact(f)
+            if audit_on:
+                _, frontier, dig = writer.audit_snapshot()
+                watchdog.note_host("http://writer", frontier, dig)
+                if i % 8 == 7:  # the agent loop's audit_eval_every cadence
+                    watchdog.evaluate()
+        return time.perf_counter() - t0
+    finally:
+        gc.enable()
+
+
+def _ab_audit(rounds: int, blocks: int) -> dict:
+    """Interleaved audit-on/audit-off A/B; returns the JSON row (same
+    shape and <= 5% acceptance bar as the registry A/Bs)."""
+    on, off = [], []
+    for _ in range(blocks):
+        on.append(_run_audit_block(rounds, True))
+        off.append(_run_audit_block(rounds, False))
+    t_on = min(on) / rounds
+    t_off = min(off) / rounds
+    overhead_pct = 100.0 * (t_on - t_off) / t_off
+    return {
+        "metric": "obs_overhead_audit_round",
+        "value": round(overhead_pct, 2),
+        "unit": "%",
+        "vs_baseline": None,
+        "note": (
+            f"divergence-audit plane on vs off (real registry both arms) "
+            f"over {blocks}x{rounds} interleaved rounds "
+            f"({t_on * 1e6:.1f}us vs {t_off * 1e6:.1f}us/round); "
+            f"acceptance <= 5%: "
+            f"{'PASS' if overhead_pct <= 5.0 else 'FAIL'}"
+        ),
+        "us_per_round_real": round(t_on * 1e6, 2),
+        "us_per_round_null": round(t_off * 1e6, 2),
+    }
+
+
 def _ab(block_fn, rounds: int, blocks: int, metric: str):
     """Interleaved A/B over one block function; returns the JSON row."""
     from crdt_tpu.obs.registry import NULL_REGISTRY, MetricsRegistry
@@ -188,6 +279,8 @@ def main() -> int:
                     help="interleaved A/B blocks per config")
     ap.add_argument("--skip-ks", action="store_true",
                     help="host-plane block only (the pre-keyspace shape)")
+    ap.add_argument("--skip-audit", action="store_true",
+                    help="skip the divergence-audit-plane A/B")
     args = ap.parse_args()
 
     rows = [_ab(_run_block, args.rounds, args.blocks,
@@ -197,6 +290,8 @@ def main() -> int:
         # fast path per iteration — fewer rounds keep wall time level
         rows.append(_ab(_run_ks_block, max(1, args.rounds // 2),
                         args.blocks, "obs_overhead_ks_round"))
+    if not args.skip_audit:
+        rows.append(_ab_audit(args.rounds, args.blocks))
     for line in rows:
         print(json.dumps(line), flush=True)
     return 0 if all(r["value"] <= 5.0 for r in rows) else 1

@@ -488,16 +488,22 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                         )
                     # the shard's stability summary rides the BODY: a
                     # round pulls several shards and the header slot
-                    # holds only one summary (net.RemotePeer)
-                    vv, frontier = ks.vv_snapshot(shard)
-                    self._send_bytes(200, json.dumps({
+                    # holds only one summary (net.RemotePeer).  The
+                    # audit digest (clamped at the same frontier) rides
+                    # beside it — zero extra round trips
+                    vv, frontier, dig = ks.audit_snapshot(shard)
+                    body = {
                         "payload": payload,
                         "vv": {str(r): s for r, s in vv.items()},
                         "frontier": {str(r): s
                                      for r, s in frontier.items()},
-                    }).encode(), "application/json",
-                        extra_headers={TRACE_HEADER: trace} if trace
-                        else None)
+                    }
+                    if dig is not None:
+                        body["digest"] = dig
+                    self._send_bytes(200, json.dumps(body).encode(),
+                                     "application/json",
+                                     extra_headers={TRACE_HEADER: trace}
+                                     if trace else None)
                 elif url.path == "/ks/data":
                     if not self.node.alive:
                         self._send(502, "Unreachable")
@@ -531,6 +537,8 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     keyspace=self.keyspace,
                     ks_door=self.ks_door,
                     leases=self.leases,
+                    watchdog=getattr(getattr(admin, "agent", None),
+                                     "watchdog", None),
                 )
                 self._send(200, body, PROM_CTYPE)
             elif url.path == "/fleet":
@@ -551,6 +559,8 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     keyspace=self.keyspace,
                     ks_door=self.ks_door,
                     leases=self.leases,
+                    watchdog=getattr(getattr(admin, "agent", None),
+                                     "watchdog", None),
                 )
                 texts = {str(self.node.rid): own}
                 agent = getattr(admin, "agent", None)
@@ -574,6 +584,17 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                 report = fleet_lib.fleet_from_texts(
                     texts, slo=slo or None, events=self.node.events)
                 self._send(200, json.dumps(report), "application/json")
+            elif url.path == "/audit":
+                # divergence audit report (crdt_tpu.obs.audit): watchdog
+                # state, per-plane frontier-anchored digests, recorded
+                # divergences — the `python -m crdt_tpu.obs audit` feed
+                wd = getattr(getattr(admin, "agent", None),
+                             "watchdog", None)
+                if wd is None:
+                    self._send(404, "no audit watchdog on this node")
+                else:
+                    self._send_bytes(200, wd.report_json(),
+                                     "application/json")
             elif url.path == "/ping":
                 if self.node.ping():
                     self._send(200, "Pong")
@@ -628,10 +649,14 @@ def _make_handler(cluster: LocalCluster, idx: int, admin=None):
                     )
                 # every gossip response piggybacks this node's stability
                 # summary — the zero-round-trip feed of the fleet-wide
-                # stable frontier (crdt_tpu.consistency.stability)
-                vv, frontier = self.node.vv_snapshot()
+                # stable frontier (crdt_tpu.consistency.stability) — and,
+                # when the audit plane is on, the digest clamped at the
+                # SAME frontier (one atomic snapshot: obs.audit needs the
+                # digest and frontier to travel as a pair)
+                vv, frontier, dig = self.node.audit_snapshot()
                 extra = {STABILITY_HEADER:
-                         encode_summary(self.node.rid, vv, frontier)}
+                         encode_summary(self.node.rid, vv, frontier,
+                                        digest=dig)}
                 if trace:
                     extra[TRACE_HEADER] = trace
                 self._send_bytes(200, body, "application/json",

@@ -45,6 +45,7 @@ from crdt_tpu.consistency.stability import (
     StabilityTracker,
     decode_summary,
 )
+from crdt_tpu.obs.audit import AuditWatchdog
 from crdt_tpu.obs.events import EventLog
 from crdt_tpu.obs.trace import TRACE_HEADER, mint_trace_id, span
 from crdt_tpu.utils.config import ClusterConfig
@@ -711,10 +712,14 @@ def network_compact(node: ReplicaNode, peers: List[RemotePeer]) -> Dict[int, int
     with ThreadPoolExecutor(max_workers=max(len(peers), 1)) as pool:
         # per-peer calls are independent: collect concurrently so one slow
         # member costs one timeout, not N (the coordinator's gossip loop is
-        # blocked for the duration of the barrier)
-        for got in pool.map(lambda p: p.version_vector(), peers):
-            if got is None:
-                return {}  # unreachable member: cannot prove stability
+        # blocked for the duration of the barrier).  Drain ALL fetches
+        # before judging: bailing out of map() mid-iteration cancels the
+        # not-yet-started ones, which turns the barrier's wire-call count
+        # into a thread-scheduling race (the nemesis census pins it).
+        collected = list(pool.map(lambda p: p.version_vector(), peers))
+        if any(got is None for got in collected):
+            return {}  # unreachable member: cannot prove stability
+        for got in collected:
             vvs.append(got[0])
             frontiers.append(got[1])
         frontier = stable_frontier_host(vvs, frontiers)
@@ -790,6 +795,20 @@ class NetworkAgent:
         # fed from the summaries riding /ks/gossip response bodies
         self.keyspace = keyspace
         self.ks_trackers = self._build_ks_trackers()
+        # live divergence audit plane (crdt_tpu.obs.audit): a gossiping
+        # agent IS the production deployment, so it digests every plane
+        # it serves and watches the digests peers piggyback back.  A
+        # NULL_REGISTRY node stays digest-free (PlaneDigest.enabled
+        # follows registry.enabled), so bare library use pays nothing.
+        node.enable_audit()
+        if keyspace is not None:
+            keyspace.enable_audit()
+        self.watchdog = AuditWatchdog(
+            node,
+            keyspace=keyspace,
+            stability=self.stability,
+            ks_trackers=self.ks_trackers,
+        )
         self._rng = random.Random(self.config.seed if seed is None else seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -819,6 +838,8 @@ class NetworkAgent:
         planes with empty peer summaries (stale pre-cutover summaries
         must not mint a frontier against reborn seq spaces)."""
         self.ks_trackers = self._build_ks_trackers()
+        # the watchdog's per-shard stall evaluator reads these trackers
+        self.watchdog.ks_trackers = self.ks_trackers
 
     def gossip_once(self) -> bool:
         """One pull round from a random peer: KV log + (when both ends
@@ -888,6 +909,9 @@ class NetworkAgent:
         s = take() if take is not None else None
         if s is not None:
             self.stability.note(peer.url, s["vv"], s["frontier"])
+            dig = s.get("digest")
+            if dig is not None:
+                self.watchdog.note_host(peer.url, s["frontier"], dig)
 
     def _available_peers(self) -> List[RemotePeer]:
         """Peers not inside a transport-failure backoff window.  Skips are
@@ -1080,6 +1104,9 @@ class NetworkAgent:
             except (ValueError, TypeError):
                 continue  # summary malformed: merge stood, tracker skips
             trackers[i].note(peer.url, vv, frontier)
+            dig = body.get("digest")
+            if dig is not None:
+                self.watchdog.note_shard(peer.url, i, frontier, dig)
         self.metrics.inc("net_ks_pulls")
         if fresh_total:
             self.metrics.inc("net_ks_fresh", fresh_total)
@@ -1142,6 +1169,9 @@ class NetworkAgent:
             except (ValueError, TypeError):
                 continue  # summary malformed: merge stood, tracker skips
             trackers[i].note(peer.url, vv, frontier)
+            dig = body.get("digest")
+            if dig is not None:
+                self.watchdog.note_shard(peer.url, i, frontier, dig)
         self.metrics.inc("net_ks_pulls")
         if fresh_total:
             self.metrics.inc("net_ks_fresh", fresh_total)
@@ -1503,6 +1533,11 @@ class NetworkAgent:
                 sge = self.config.stability_gc_every
                 if self.coordinator and sge and rounds % sge == 0:
                     self.stability_gc_once()
+                # watchdog evaluators tick on EVERY node (divergence and
+                # stall detection must not die with the coordinator)
+                aee = self.config.audit_eval_every
+                if aee and rounds % aee == 0:
+                    self.watchdog.evaluate()
             except Exception as e:  # noqa: BLE001 — surfaced via stop()
                 self.metrics.inc("net_gossip_loop_errors")
                 with self._err_lock:
@@ -1674,6 +1709,20 @@ class NodeHost:
             # reshard reshape hook: a cutover swaps the plane set and
             # everything host-side that cached it must re-bind
             self.keyspace.on_reshape(self._on_ks_reshape)
+        # divergence-audit wiring the agent cannot see from inside: the
+        # lease table (zombie-window evaluator) and the auto-postmortem
+        # sink.  The bundle lands beside whatever durable artifact the
+        # host already writes — the checkpoint dir or the event log.
+        self.agent.watchdog.leases = self.leases
+        pm_dir = checkpoint_dir
+        if pm_dir is None and event_log:
+            import os as _os
+            pm_dir = _os.path.dirname(_os.path.abspath(event_log))
+        if pm_dir:
+            self.agent.watchdog.configure_postmortem(
+                pm_dir, self.config.seed,
+                [event_log] if event_log else [],
+            )
         # strong read/CAS coordinator (crdt_tpu.consistency): reads
         # agent.peers LIVE so a harness that swaps the peer list for
         # FaultyTransports after boot keeps the plane inside the fault

@@ -263,7 +263,7 @@ class PendingMerge:
     """
 
     __slots__ = ("node", "ops", "fresh", "adopted", "rows", "births",
-                 "vv_before", "recording", "done")
+                 "vv_before", "recording", "done", "dig", "dig_sum")
 
     def __init__(self, node: "ReplicaNode"):
         self.node = node
@@ -277,6 +277,12 @@ class PendingMerge:
         self.vv_before: Optional[Dict[int, int]] = None
         self.recording = False
         self.done = False
+        # audit-digest carry (crdt_tpu.obs.audit): per-row digest lanes
+        # of the packed batch (fresh, 4 uint32) + their host-side lane
+        # sum — the mesh plane folds the same rows on-device inside its
+        # fused dispatch and commit() verifies the two sums bit-equal
+        self.dig: Optional[np.ndarray] = None
+        self.dig_sum: Optional[np.ndarray] = None
 
     def rows_held(self) -> int:
         """Live log rows of the plane (caller of the fused step sizes the
@@ -287,17 +293,32 @@ class PendingMerge:
             self.node._log_rows = n
         return n
 
-    def commit(self, merged_log, n_unique: int) -> int:
+    def commit(self, merged_log, n_unique: int, digest=None) -> int:
         """Finish the deferred merge with the FUSED step's output lane:
         rebind the log, finish accounting, release the node lock.
         ``n_unique`` must already be a host int (the mesh plane syncs the
-        whole lane-count vector in one transfer)."""
+        whole lane-count vector in one transfer).  ``digest`` (optional)
+        is the device-folded lane sum of this lane's audit-digest rows,
+        synced in the same transfer — bit-compared against the host-side
+        sum (continuous mesh-vs-host digest parity; a mismatch emits
+        ``audit_mesh_mismatch`` rather than failing the merge, since the
+        merged log itself is already checked by the sorted union)."""
         node = self.node
         try:
             if self.fresh:
                 assert n_unique <= merged_log.ts.shape[-1], (
                     f"fused union {n_unique} rows overflowed lane capacity "
                     f"{merged_log.ts.shape[-1]}")
+                if digest is not None and self.dig_sum is not None:
+                    dev = np.asarray(digest, np.uint32)
+                    if not np.array_equal(dev, self.dig_sum):
+                        from crdt_tpu.ops import digest as digkernel
+
+                        node.metrics.inc("audit_mesh_mismatch")
+                        node.events.emit(
+                            "audit_mesh_mismatch",
+                            host=digkernel.digest_hex(self.dig_sum),
+                            device=digkernel.digest_hex(dev))
                 node.log = merged_log
                 node._log_rows = int(n_unique)
                 node.metrics.inc("ops_ingested", self.fresh)
@@ -460,6 +481,10 @@ class ReplicaNode:
         # summary only changes on compact/adopt, but get_state() needs it as
         # device arrays every call
         self._summary_cache: Optional[Tuple[compactlog.Summary, int]] = None
+        # live divergence audit plane (crdt_tpu.obs.audit): incremental
+        # winner-row digest, opt-in via enable_audit() — bare nodes pay
+        # one `is not None` check on the ingest hot paths
+        self.digest = None
 
     # ---- write path ----
 
@@ -875,8 +900,15 @@ class ReplicaNode:
                         )
                     rows_all.extend(rows)
                 pending.rows = rows_all
+                accepted = self._accept_locked(rows_all)
                 pending.ops, pending.fresh = self._pack_accepted_locked(
-                    self._accept_locked(rows_all))
+                    accepted)
+                if pending.fresh and self.digest is not None \
+                        and self.digest.enabled:
+                    pending.dig = self.digest.dig_column(
+                        accepted, self.clock.epoch_ms)
+                    pending.dig_sum = pending.dig.sum(
+                        axis=0, dtype=np.uint32)
         except BaseException:
             self._lock.release()
             raise
@@ -923,6 +955,13 @@ class ReplicaNode:
             pending.ops, pending.fresh = self._pack_local_batch(
                 cmds, tss, seq0)
             epoch = self.clock.epoch_ms
+            if pending.fresh and self.digest is not None \
+                    and self.digest.enabled:
+                pending.dig = self.digest.dig_column(
+                    [(t, self.rid, seq0 + i, c)
+                     for i, (c, t) in enumerate(zip(cmds, tss))],
+                    epoch)
+                pending.dig_sum = pending.dig.sum(axis=0, dtype=np.uint32)
             pending.births = [(seq0 + i, t + epoch)
                               for i, t in enumerate(tss)]
             rid = self.rid
@@ -930,6 +969,74 @@ class ReplicaNode:
         except BaseException:
             self._lock.release()
             raise
+
+    # ---- live divergence audit (crdt_tpu.obs.audit) ----
+
+    def enable_audit(self, plane: str = "host"):
+        """Opt in to the live divergence audit plane: attach an
+        incremental winner-row digest (crdt_tpu.obs.audit.PlaneDigest)
+        and seed it from the current store.  Idempotent (re-labels +
+        reseeds); returns the digest.  Enablement additionally rides
+        ``metrics.registry.enabled``, so a NULL_REGISTRY node stays
+        digest-free even after this call."""
+        from crdt_tpu.obs.audit import PlaneDigest
+
+        with self._lock:
+            if self.digest is None:
+                self.digest = PlaneDigest(self, plane=plane)
+            else:
+                self.digest.plane = plane
+            self.digest.resync()
+        return self.digest
+
+    def audit_digest_at(self, frontier: Dict[int, int]) -> Optional[str]:
+        """Hex digest of this node's state clamped at ``frontier``, or
+        None when the clamp is not comparable here: the digest below F is
+        well-defined only while this node's own compaction frontier <= F
+        (folded non-winner candidates under our fold are gone) and
+        F <= our vv (we have actually seen everything under F).  Inside
+        that window the below-F winner set is immutable, so the result
+        is independent of in-flight ops and delivery order."""
+        with self._lock:
+            d = self.digest
+            if d is None or not d.enabled:
+                return None
+            frontier = {int(r): int(s) for r, s in frontier.items()}
+            if not all(frontier.get(r, -1) >= s
+                       for r, s in self._frontier.items()):
+                return None
+            vv = self._version_vector_locked()
+            if not all(s <= vv.get(r, -1) for r, s in frontier.items()):
+                return None
+            return d.digest_hex_at(frontier)
+
+    def audit_snapshot(self) -> Tuple[Dict[int, int], Dict[int, int],
+                                      Optional[str]]:
+        """One-lock (vv, frontier, digest-at-frontier-hex) snapshot — the
+        gossip piggyback source (api.http_shim): the digest MUST be
+        clamped at the same frontier the stability summary carries, so
+        the three travel as one atomic read."""
+        with self._lock:
+            vv = self._version_vector_locked()
+            frontier = dict(self._frontier)
+            d = self.digest
+            dig = (d.digest_hex_at(frontier)
+                   if d is not None and d.enabled else None)
+        return vv, frontier, dig
+
+    def audit_scrub(self) -> bool:
+        """Recompute the digest FROM the store and adopt it; True when
+        the accumulator disagreed (the store changed underneath the
+        digest — silent corruption entering the served digest)."""
+        with self._lock:
+            d = self.digest
+            if d is None or not d.enabled:
+                return False
+            return d.scrub()
+
+    def _digest_resync_locked(self) -> None:
+        if self.digest is not None and self.digest.enabled:
+            self.digest.resync()
 
     # ---- health / fault injection ----
 
@@ -999,6 +1106,9 @@ class ReplicaNode:
             folded.summary, folded.summary.num.shape[-1]
         )
         self._prune_commands_locked()
+        # the fold rewrote the store wholesale — rebuild the audit digest
+        # from it (O(state) exactly where an O(state) rewrite already is)
+        self._digest_resync_locked()
 
     def _adopt_frontier_locked(
         self, remote_frontier: Dict[int, int], remote_summary: Dict[str, Any]
@@ -1067,6 +1177,7 @@ class ReplicaNode:
         )
         self._log_rows = None
         self._prune_commands_locked()
+        self._digest_resync_locked()  # the adopted summary replaced ours
         self.metrics.inc("frontier_adoptions")
         self.events.emit(
             "frontier_adopt", trace=current_trace(),
@@ -1129,6 +1240,7 @@ class ReplicaNode:
         for r, s in self._frontier.items():
             if s > self._vv.get(r, -1):
                 self._vv[r] = s
+        self._digest_resync_locked()  # restore path: reseed from store
 
     def _frontier_array(self, frontier: Dict[int, int], n_writers: int):
         import jax.numpy as jnp
@@ -1245,6 +1357,8 @@ class ReplicaNode:
             else:
                 self._foreign.append((ident, stored))
             accepted.append((ts, rid, seq, stored))
+        if accepted and self.digest is not None and self.digest.enabled:
+            self.digest.observe_rows(accepted, self.clock.epoch_ms)
         return accepted
 
     def _pack_accepted_locked(
@@ -1381,6 +1495,11 @@ class ReplicaNode:
                 pending.append((ts + epoch, rid, seq, ent[1], ent[2]))
             seq += 1
         self._vv[rid] = max(self._vv.get(rid, -1), seq - 1)
+        if self.digest is not None and self.digest.enabled:
+            self.digest.observe_rows(
+                [(t, rid, seq0 + i, c) for i, (c, t) in
+                 enumerate(zip(cmds, tss))],
+                epoch)
         fresh = len(c_eidx)
         if not fresh:
             return None, 0

@@ -49,30 +49,43 @@ STABILITY_HEADER = "X-CRDT-Stability"
 
 
 def encode_summary(rid: int, vv: Dict[int, int],
-                   frontier: Dict[int, int]) -> str:
+                   frontier: Dict[int, int],
+                   digest: Optional[str] = None) -> str:
     """Header value for one node's summary (JSON keeps keys as strings,
-    same wire convention as the /vv body)."""
-    return json.dumps({
+    same wire convention as the /vv body).  ``digest`` (optional) is the
+    serving node's audit digest clamped AT ``frontier``
+    (crdt_tpu.obs.audit) — it rides the same header, so the divergence
+    audit plane costs zero extra round trips."""
+    d: Dict[str, Any] = {
         "rid": int(rid),
         "vv": {str(r): int(s) for r, s in vv.items()},
         "frontier": {str(r): int(s) for r, s in frontier.items()},
-    }, separators=(",", ":"))
+    }
+    if digest is not None:
+        d["digest"] = str(digest)
+    return json.dumps(d, separators=(",", ":"))
 
 
 def decode_summary(raw: Optional[str]) -> Optional[Dict[str, Any]]:
     """Parse a header value; garbage (truncated/corrupt header) decodes to
     None and the round simply contributes no summary — same skip-don't-die
-    posture as RemotePeer._parse."""
+    posture as RemotePeer._parse.  ``digest`` passes through untyped (the
+    AuditWatchdog validates its shape itself; a node without the audit
+    plane simply omits it)."""
     if not raw:
         return None
     try:
         d = json.loads(raw)
-        return {
+        out = {
             "rid": int(d["rid"]),
             "vv": {int(r): int(s) for r, s in (d.get("vv") or {}).items()},
             "frontier": {int(r): int(s)
                          for r, s in (d.get("frontier") or {}).items()},
         }
+        dig = d.get("digest")
+        if dig is not None:
+            out["digest"] = dig
+        return out
     except (ValueError, TypeError, KeyError):
         return None
 

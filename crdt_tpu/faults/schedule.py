@@ -76,6 +76,22 @@ def reshard_window_rules(start: int, end: int) -> List["FaultRule"]:
     ]
 
 
+def divergence_rules(start: int, end: int, node: str = "*",
+                     p: float = 1.0) -> List["FaultRule"]:
+    """The planted silent-corruption fault (crdt_tpu.obs.audit): a
+    ``flip`` rule on the ``op="state"`` pseudo-edge.  Not a message
+    fault — the soak driver asks ``decide(node, node, "state")`` once
+    per (node, round) and, when the flip fires, calls
+    ``plant_divergence`` on that node post-merge: one committed row's
+    winner timestamp silently changes without the incremental digest
+    hearing about it.  Appended explicitly like ``reshard_window_rules``
+    (never ``generate()``d): a planted divergence is opted into by the
+    audit soak alone, whose oracle then holds ``divergence_detected``
+    provenance against exactly these decisions, 1:1."""
+    return [FaultRule("flip", src=node, dst=node, op="state",
+                      start=start, end=end, p=p)]
+
+
 @dataclasses.dataclass(frozen=True)
 class SkewEvent:
     """At ``step``, shift node ``node``'s clock epoch by ``skew_ms`` —
@@ -220,6 +236,12 @@ class FaultPlane:
         # shims concurrently) and read by the driver — lock every access
         self._lock = threading.Lock()
         self.log: List[Dict[str, Any]] = []
+        # decide() calls so far, by op.  Every shimmed wire call asks
+        # exactly once (pre-heal and post-heal alike), so this histogram
+        # IS the run's wire-call census — the audit soak pins its
+        # zero-new-round-trips claim on the census matching a digest-free
+        # arm of the same seed exactly.
+        self.decisions: Dict[str, int] = {}
         self._file = open(log_path, "a") if log_path else None
 
     def decide(self, src: str, dst: str, op: str) -> Dict[str, FaultRule]:
@@ -227,7 +249,10 @@ class FaultPlane:
         {kind: rule} for every kind whose FIRST matching rule wins its
         probability coin.  The coin is keyed by the full decision identity
         — same seed, same step, same edge, same rule index → same flip,
-        on any host, in any process."""
+        on any host, in any process.  Decisions stay pure (nothing in the
+        fault log); only the per-op call census is counted."""
+        with self._lock:
+            self.decisions[op] = self.decisions.get(op, 0) + 1
         if self.healed:
             return {}
         step = self.step

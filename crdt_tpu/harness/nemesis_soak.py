@@ -61,6 +61,25 @@ and clock skew — then heals and asserts the CRDT laws held:
   shards), per-tenant views must equal the admission ledger across
   S -> S', and every fence and migration quarantine reconciles 1:1
   against the driver's predictions (``_check_reshard_oracle``);
+* **divergence audit** (``--audit``) — the live audit plane
+  (crdt_tpu.obs.audit) rides the default action table under fire: the
+  coordinator mints stability frontiers on the --gc cadence (digests
+  only compare at non-empty frontiers), every node's watchdog ticks
+  once per step, and the schedule carries ``flip`` rules on the
+  ``op="state"`` pseudo-edge — when one fires, the driver silently
+  flips a committed row's winner timestamp post-merge
+  (``plant_divergence``) and convicts it SYNCHRONOUSLY via the
+  watchdog's store scrub, so ``audit_scrub_drift`` events reconcile
+  1:1 against the planted-flip fault records.  The corruption is
+  pinned into a durable generation (and audit crashes are durable),
+  so no fallback restore can un-plant it; after heal, the
+  frontier-anchored digest comparison must raise
+  ``divergence_detected`` implicating EXACTLY the planted nodes, with
+  an auto-postmortem bundle on disk.  ``run_soak`` replays a
+  plant-free arm of the same seed: it must stay divergence-silent
+  (zero false positives under the full fault schedule) and its per-op
+  wire-call census must equal the planted arm's exactly — digests and
+  convictions piggyback on existing exchanges, zero new round trips;
 * **strong never-stale** (``--strong``) — a ``strong_op`` action mixes
   linearizable reads and CAS (crdt_tpu.consistency.plane) into the fault
   schedule.  Node clocks are re-pinned each step into disjoint ms bands
@@ -232,6 +251,16 @@ class NemesisReport:
     rs_streams: int = 0
     rs_fences: int = 0
     rs_quarantines: int = 0
+    # --audit accounting: planted silent corruptions (fault plane op
+    # "state"), their 1:1 scrub convictions, the divergence events the
+    # frontier-anchored comparison raised, auto-postmortem bundles, and
+    # the per-op decide() census the zero-new-round-trips pin compares
+    # against the plant-free arm
+    audit_planted: int = 0
+    audit_drifts: int = 0
+    audit_divergences: int = 0
+    audit_postmortems: int = 0
+    wire_census: Optional[Dict[str, int]] = None
 
     def summary(self) -> str:
         faults = ", ".join(
@@ -290,6 +319,13 @@ class NemesisReport:
                      f"{self.strong_unavailable} unavailable (1:1 events, "
                      f"{self.strong_indeterminate} indeterminate), "
                      f"{self.strong_conflicts} cas conflicts, never stale")
+        if self.audit_planted or self.audit_divergences:
+            prop += (f"; audit: {self.audit_planted} planted flip(s) -> "
+                     f"{self.audit_drifts} scrub conviction(s), "
+                     f"{self.audit_divergences} divergence event(s), "
+                     f"{self.audit_postmortems} auto-postmortem(s)")
+        elif self.wire_census is not None:
+            prop += "; audit: clean arm, 0 divergence events"
         if self.coordinator_crashes or self.zombie_attempts:
             prop += (f"; coordinator: {self.coordinator_crashes} "
                      f"leaseholder crashes, {self.zombie_attempts} zombie "
@@ -387,7 +423,7 @@ class _Slot:
         self.host.agent.peers = list(self.transports.values())
         ident = self.soak.member_ident
         self.host.leases.member_key = lambda u: ident.get(u, u)
-        if self.soak.gc or self.soak.strong:
+        if self.soak.gc or self.soak.strong or self.soak.audit:
             # the stability tracker's staleness windows age in plane
             # steps (same time base as the breakers), and the consistency
             # plane's wait loops run on fake seconds that advance only
@@ -415,13 +451,18 @@ class _Slot:
 
         Strong mode crashes fail-STOP, not fail-amnesia: a quorum ack
         promises the op is on stable storage, so the never-stale audit is
-        only sound if acked state survives the crash.  The flush is a
+        only sound if acked state survives the crash.  Audit-mode crashes
+        are durable for the mirror reason: an amnesia reboot can regress
+        a vv below an already-minted frontier, and the wire-summary
+        adoption that follows would heal or spread the planted corruption
+        mid-run, voiding the 1:1 divergence accounting.  The flush is a
         direct atomic save (no FaultyDisk tearing — a torn fsync'd ack is
         a different fault model).  ``durable=False`` keeps the amnesia
         crash for the plant-and-recover scenario, whose fallback restore
         deliberately drops a never-acked, never-gossiped write."""
         assert self.host is not None
-        if self.soak.strong if durable is None else durable:
+        if ((self.soak.strong or self.soak.audit)
+                if durable is None else durable):
             from crdt_tpu.utils import checkpoint as ckpt
 
             h = self.host
@@ -472,7 +513,9 @@ class NemesisSoak:
                  crash_coordinator: bool = False,
                  multitenant: bool = False,
                  reshard: bool = False,
-                 ks_mesh: str = "auto"):
+                 ks_mesh: str = "auto",
+                 audit: bool = False,
+                 audit_plant: bool = True):
         # --reshard rides the multitenant action table: the tenant
         # admission ledger IS the zero-lost-ops oracle across S -> S'
         multitenant = multitenant or reshard
@@ -497,6 +540,12 @@ class NemesisSoak:
             "--multitenant drives its own action table over the keyspace "
             "tier; run the other modes as separate soaks"
         )
+        assert not (audit and (strong or overload or composite or gc
+                               or multitenant)), (
+            "--audit rides the default action table with its own frontier "
+            "cadence and durable-crash rule; run the other modes as "
+            "separate soaks"
+        )
         self.seed = seed
         self.steps = steps
         self.postmortem_dir = postmortem_dir
@@ -512,6 +561,19 @@ class NemesisSoak:
         # handoffs join the strong table; the fence-decision oracle
         # (<=1 decider per (slot, fence)) gates the heal
         self.crash_coordinator = crash_coordinator
+        # audit mode: the divergence audit plane under fire — frontier
+        # GC on the --gc cadence (digests only compare at non-empty
+        # frontiers), a watchdog tick every step, and (plant arm) silent
+        # winner-ts flips scheduled on the op="state" pseudo-edge.
+        # Crashes are DURABLE here: an amnesia reboot could regress a vv
+        # below an already-minted frontier, and the resulting
+        # wire-summary adoption would heal (or spread) the planted
+        # corruption mid-run — breaking the 1:1 provenance accounting
+        # both ways.
+        self.audit = audit
+        self.audit_plant = audit and audit_plant
+        self.audit_planted: List[Dict[str, Any]] = []
+        self._audit_planted_slots: set = set()
         # driver-side truth for the --gc summary audit: running pointwise
         # max of every member's vv, sampled at the end of every step (a
         # summary may lag but can never exceed this)
@@ -591,9 +653,26 @@ class NemesisSoak:
         # strong mode disables schedule clock skew: linearizable CAS over
         # an LWW register needs ts order == mint order, which the per-step
         # clock pinning provides and a skew event would re-break.  Skew
-        # tolerance stays pinned by the default soak.
+        # tolerance stays pinned by the default soak.  Audit mode drops
+        # skew too: a skew event mutates epoch_ms in place, silently
+        # re-timing every already-hashed absolute-ts row — a legitimate
+        # store-vs-digest drift the scrub would convict with no planted
+        # fault behind it, voiding the 1:1 accounting (cross-epoch digest
+        # comparability is pinned by tests/test_audit.py instead).
         self.schedule = NemesisSchedule.generate(
-            seed, nodes, steps, clock_skew=not strong)
+            seed, nodes, steps, clock_skew=not (strong or audit))
+        if self.audit_plant:
+            # flip windows on the op="state" pseudo-edge, appended BEFORE
+            # the plane exists so --replay-check covers these rules too;
+            # the window opens late enough for the first frontier fold to
+            # have populated _summary (plants target folded rows)
+            from crdt_tpu.faults.schedule import divergence_rules
+
+            self.schedule = dataclasses.replace(
+                self.schedule,
+                rules=self.schedule.rules + tuple(
+                    divergence_rules(max(2, steps // 4), steps, p=0.1)),
+            )
         if reshard:
             # aim corrupt + drop windows at the migration stream itself
             # (op "ks_migrate"); appended BEFORE the plane exists so the
@@ -1323,6 +1402,27 @@ class NemesisSoak:
             if step % self.GC_EVERY == 0:
                 self._drive_gc(step)
             self._sample_true_vvs()
+        if self.audit:
+            # same rule: the audit drive sits OUTSIDE the action rng, so
+            # the plant-free arm replays the identical action stream and
+            # issues the identical decide() calls — the wire-call census
+            # comparison in run_soak is exact
+            if step % self.GC_EVERY == 0:
+                # the action table's one-random-edge pulls are too sparse
+                # for the coordinator to hold a FRESH summary from every
+                # member, so mid-run mints would never fire and no row
+                # would ever fold for a plant to flip: refresh the
+                # coordinator's tracker through its faulty transports
+                # first (partitions still starve it — mints only land in
+                # clean windows, which is the point of a soak)
+                coord = self.slots[0]
+                if coord.alive:
+                    for t in coord.transports.values():
+                        if not t.backed_off():
+                            coord.host.agent.pull_from(t)
+                self._drive_gc(step)
+            self._sample_true_vvs()
+            self._drive_audit(step)
 
     # ---- --gc: coordinated GC drive + the safety oracle ----
 
@@ -1438,6 +1538,183 @@ class NemesisSoak:
                 f"{len(s.host.node._commands)} raw commands after the "
                 "full-vv fold"
             )
+
+    # ---- --audit: planted-flip drive + the 1:1 detection oracle ----
+
+    def _drive_audit(self, step: int) -> None:
+        """Per-step audit drive: consult the ``op="state"`` pseudo-edge
+        for every slot (the decide() coins are consulted unconditionally
+        so the census matches the plant-free arm exactly), plant at most
+        one silent flip per slot, convict it SYNCHRONOUSLY via the
+        watchdog's store scrub (the 1:1 ``audit_scrub_drift`` accounting
+        must not race a later fold's resync, which would adopt the
+        corruption silently), pin it into a durable generation so no
+        fallback restore can un-plant it, then tick every live
+        watchdog."""
+        from crdt_tpu.obs.audit import plant_divergence
+        from crdt_tpu.utils import checkpoint as ckpt
+
+        for s in self.slots:
+            hits = self.plane.decide(str(s.slot), str(s.slot), "state")
+            if ("flip" not in hits or not s.alive
+                    or s.slot in self._audit_planted_slots):
+                continue
+            w = plant_divergence(s.host.node)
+            if w is None:
+                continue  # nothing folded yet; a later window coin retries
+            self._audit_planted_slots.add(s.slot)
+            # identity fields only: the flipped timestamps are wall-clock
+            # LWW stamps, and the fault log must stay byte-identical
+            # across same-seed runs (--replay-check); the full witness
+            # (ts_before/ts_after) lives in audit_planted for the oracle
+            self.plane.record("state_flip", slot=str(s.slot),
+                              node=w["node"], key=w["key"])
+            self.audit_planted.append({"step": step, "slot": s.slot, **w})
+            drifted = s.host.agent.watchdog.scrub()
+            assert any(d["plane"] == "host" for d in drifted), (
+                f"planted flip on slot {s.slot} survived a store scrub: "
+                "the digest recompute missed a corrupted winner row"
+            )
+            h = s.host
+            ckpt.save_node_atomic(
+                s.ckpt_dir, h.node, set_node=h.set_node,
+                seq_node=h.seq_node, map_node=h.map_node,
+                composite_node=h.composite_node,
+                keyspace=h.keyspace, leases=h.leases,
+            )
+        for s in self._alive():
+            s.host.agent.watchdog.evaluate()
+
+    def _check_audit(self) -> None:
+        """The post-heal audit oracle, in three movements.  (1) A final
+        detection sweep — breakers aged shut, one fresh mint over the
+        converged fleet, two exchange rounds at the new frontier, a
+        watchdog tick everywhere — identical in both arms, so the wire
+        census stays comparable.  (2) Plant arm: every planted flip is
+        still live in its store (the durable-crash rule held), scrub
+        convictions reconcile 1:1 against the planted-flip fault records,
+        every ``divergence_detected`` pair implicates a planted node and
+        every planted node is implicated, and an auto-postmortem bundle
+        with the digest witnesses landed on disk.  (3) Plant-free arm:
+        the machinery was demonstrably LIVE (every node compared digests
+        at the shared post-heal frontier and reports AUDIT_OK) yet raised
+        ZERO drift or divergence events — no false positives under the
+        full fault schedule."""
+        import tarfile
+
+        from crdt_tpu.obs import audit as audit_mod
+
+        for _ in range(6):  # > breaker backoff cap: every circuit closes
+            self.plane.step += 1
+            for src in self.slots:
+                for dst in src.peer_slots:
+                    t = src.transports[dst]
+                    if not t.backed_off():
+                        src.host.agent.pull_from(t)
+        before = self.report.gc_mints
+        self._drive_gc(self.plane.step)
+        assert self.report.gc_mints == before + 1, (
+            "post-heal audit mint failed despite a converged, fully-fresh "
+            "fleet (tracker stalled on stale summaries?)"
+        )
+        for _ in range(2):  # exchange digests at the fresh frontier
+            self.plane.step += 1
+            for src in self.slots:
+                for dst in src.peer_slots:
+                    src.host.agent.pull_from(src.transports[dst])
+        for s in self.slots:
+            s.host.agent.watchdog.evaluate()
+
+        drifts: List[Tuple[int, Dict[str, Any]]] = []
+        divs: List[Tuple[int, Dict[str, Any]]] = []
+        posts: List[Tuple[int, Dict[str, Any]]] = []
+        for s in self.slots:
+            for e in read_jsonl(s.event_log_path):
+                ev = e.get("event")
+                if ev == "audit_scrub_drift":
+                    drifts.append((s.slot, e))
+                elif ev == "divergence_detected":
+                    divs.append((s.slot, e))
+                elif ev == "audit_postmortem":
+                    posts.append((s.slot, e))
+        self.report.audit_planted = len(self.audit_planted)
+        self.report.audit_drifts = len(drifts)
+        self.report.audit_divergences = len(divs)
+        self.report.wire_census = dict(sorted(
+            self.plane.decisions.items()))
+        bundles = [pathlib.Path(s.ckpt_dir) / f"postmortem-{self.seed}.tar.gz"
+                   for s in self.slots]
+
+        if self.audit_plant:
+            assert self.audit_planted, (
+                f"seed {self.seed}: the flip window produced zero planted "
+                "flips — widen the window or raise p"
+            )
+            planted = {p["slot"] for p in self.audit_planted}
+            for p in self.audit_planted:
+                e = self.slots[p["slot"]].host.node._summary.get(p["key"])
+                assert e is not None and int(e["ts"]) == p["ts_after"], (
+                    f"planted corruption on slot {p['slot']} key "
+                    f"{p['key']!r} was silently healed mid-run "
+                    f"(summary now {e}) — the durable-crash rule leaked"
+                )
+            assert len(drifts) == len(self.audit_planted), (
+                f"{len(self.audit_planted)} planted flip(s) but "
+                f"{len(drifts)} audit_scrub_drift event(s): the 1:1 "
+                "conviction accounting drifted"
+            )
+            assert {sl for sl, _ in drifts} == planted, (
+                f"scrub convictions on slots {sorted(sl for sl, _ in drifts)} "
+                f"!= planted slots {sorted(planted)}"
+            )
+            assert divs, "planted divergence was never flagged by any peer"
+            url_slot = {self._url_of(s): s.slot for s in self.slots}
+            implicated: set = set()
+            for sl, e in divs:
+                pair = {sl if side == "local" else url_slot.get(side, side)
+                        for side in (e.get("a"), e.get("b"))}
+                assert pair & planted, (
+                    f"divergence_detected between clean nodes only: {e}"
+                )
+                implicated |= pair & planted
+            assert implicated == planted, (
+                f"divergence events implicate planted slots "
+                f"{sorted(implicated)} but the driver planted "
+                f"{sorted(planted)}"
+            )
+            found = [b for b in bundles if b.exists()]
+            assert found and posts, (
+                "divergence latched but no auto-postmortem bundle landed"
+            )
+            with tarfile.open(found[0]) as tf:
+                names = tf.getnames()
+            assert any(n.endswith("audit_witnesses.json") for n in names), (
+                f"postmortem bundle {found[0]} carries no digest "
+                f"witnesses: {names}"
+            )
+            self.report.audit_postmortems = len(found)
+            for sl in planted:
+                wd = self.slots[sl].host.agent.watchdog
+                assert wd.state == audit_mod.AUDIT_DIVERGED, (
+                    f"planted slot {sl} watchdog state {wd.state} != "
+                    "AUDIT_DIVERGED after the final sweep"
+                )
+        else:
+            assert not drifts and not divs and not posts, (
+                f"plant-free audit arm raised events: drifts={drifts} "
+                f"divergences={divs} — false positive"
+            )
+            for b in bundles:
+                assert not b.exists(), (
+                    f"plant-free arm wrote a postmortem bundle: {b}"
+                )
+            for s in self.slots:
+                wd = s.host.agent.watchdog
+                assert wd.state == audit_mod.AUDIT_OK, (
+                    f"slot {s.slot} watchdog state {wd.state} != AUDIT_OK "
+                    "after the final sweep: the audit plane never compared "
+                    "digests (machinery dead, oracle vacuous)"
+                )
 
     # ---- --strong: post-heal recovery + event reconciliation ----
 
@@ -2139,6 +2416,11 @@ class NemesisSoak:
             self._check_strong_recovery()
         if self.gc:
             self._gc_final()
+        if self.audit:
+            # post-_converge on purpose: the convergence rounds already
+            # exchanged digests at the run's frontiers, so the detection
+            # sweep in here only has to pin the FINAL shared frontier
+            self._check_audit()
         if self.multitenant:
             self._check_multitenant_oracle()
             if self.reshard:
@@ -2415,7 +2697,8 @@ def run_soak(seed: int, nodes: int, steps: int,
              crash_coordinator: bool = False,
              multitenant: bool = False,
              reshard: bool = False,
-             ks_mesh: str = "auto") -> NemesisReport:
+             ks_mesh: str = "auto",
+             audit: bool = False) -> NemesisReport:
     rep = NemesisSoak(seed, nodes=nodes, steps=steps,
                       fault_log=fault_log, postmortem_dir=postmortem_dir,
                       assemble_check=assemble_check,
@@ -2423,7 +2706,7 @@ def run_soak(seed: int, nodes: int, steps: int,
                       gc=gc, strong=strong,
                       crash_coordinator=crash_coordinator,
                       multitenant=multitenant, reshard=reshard,
-                      ks_mesh=ks_mesh).run()
+                      ks_mesh=ks_mesh, audit=audit).run()
     if gc:
         # shadow arm: the IDENTICAL soak with GC never driven.  The GC
         # drive sits outside the action rng and the fault coins are pure
@@ -2458,6 +2741,31 @@ def run_soak(seed: int, nodes: int, steps: int,
             f"vs {shadow.gc_retained} without GC — no footprint win"
         )
         rep.gc_retained_shadow = shadow.gc_retained
+    if audit:
+        # plant-free arm: the IDENTICAL soak with the flip rules never
+        # planted.  The audit drive consults the same decide() coins in
+        # both arms and everything else it does sits outside the action
+        # rng, so the wire-call census must match EXACTLY — that equality
+        # IS the "digest plane adds zero new round trips" claim, pinned —
+        # and a single drift/divergence event here is a false positive.
+        clean = NemesisSoak(seed, nodes=nodes, steps=steps,
+                            postmortem_dir=postmortem_dir,
+                            audit=True, audit_plant=False).run()
+        assert clean.audit_planted == 0 and clean.audit_drifts == 0 \
+            and clean.audit_divergences == 0, (
+                f"seed {seed}: plant-free audit arm raised "
+                f"{clean.audit_drifts} drift(s) / "
+                f"{clean.audit_divergences} divergence(s): false positive"
+            )
+        assert rep.wire_census == clean.wire_census, (
+            f"seed {seed}: wire-call census diverged between the planted "
+            f"and plant-free audit arms ({rep.wire_census} vs "
+            f"{clean.wire_census}) — the audit plane added round trips"
+        )
+        assert rep.state_json == clean.state_json, (
+            f"seed {seed}: planted winner-ts flips changed the converged "
+            "STATE — the plant is supposed to be value-invisible"
+        )
     return rep
 
 
@@ -2525,6 +2833,15 @@ def main(argv=None) -> int:
                          "and the converged fleet must hold one epoch, "
                          "disjoint ownership, and ledger-exact tenant "
                          "views")
+    ap.add_argument("--audit", action="store_true",
+                    help="drive the live divergence audit plane: frontier-"
+                         "anchored state digests compared on every gossip "
+                         "round, silent planted winner-ts flips (fault op "
+                         "'state') convicted 1:1 by the watchdog's scrub "
+                         "and peer divergence_detected events with an "
+                         "auto-postmortem bundle, plus a plant-free arm "
+                         "pinning zero false positives and a bit-equal "
+                         "wire-call census (zero new round trips)")
     ap.add_argument("--ks-mesh", choices=("auto", "on", "off"),
                     default="auto",
                     help="keyspace_mesh knob for --multitenant: route "
@@ -2558,7 +2875,8 @@ def main(argv=None) -> int:
                                crash_coordinator=args.crash_coordinator,
                                multitenant=args.multitenant,
                                reshard=args.reshard,
-                               ks_mesh=args.ks_mesh)
+                               ks_mesh=args.ks_mesh,
+                               audit=args.audit)
                 run_soak(seed, args.nodes, args.steps, fault_log=log_b,
                          postmortem_dir=args.postmortem_dir,
                          composite=args.composite,
@@ -2568,7 +2886,8 @@ def main(argv=None) -> int:
                          crash_coordinator=args.crash_coordinator,
                          multitenant=args.multitenant,
                          reshard=args.reshard,
-                         ks_mesh=args.ks_mesh)
+                         ks_mesh=args.ks_mesh,
+                         audit=args.audit)
                 a = pathlib.Path(log_a).read_bytes()
                 b = pathlib.Path(log_b).read_bytes()
                 assert a == b, (
@@ -2588,7 +2907,8 @@ def main(argv=None) -> int:
                            crash_coordinator=args.crash_coordinator,
                            multitenant=args.multitenant,
                            reshard=args.reshard,
-                           ks_mesh=args.ks_mesh)
+                           ks_mesh=args.ks_mesh,
+                           audit=args.audit)
             print(f"[nemesis] {rep.summary()}")
         if args.race_check:
             rpt = race.report()

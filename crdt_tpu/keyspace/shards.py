@@ -83,6 +83,10 @@ class ShardedKeyspace:
         self.events = events
         self.clock = clock
         self._metrics_arg = metrics
+        # live divergence audit (crdt_tpu.obs.audit): once enabled, every
+        # plane _make_shard builds — including reshard cutover/restore
+        # rebirths — re-mints its digest from its (fresh) store
+        self._audit = False
         # shards share the host's metrics/events sinks: merge-dispatch
         # counters aggregate (what the bench reads) and shard events land
         # in the same black box
@@ -130,7 +134,25 @@ class ShardedKeyspace:
         shard.recorder.bind(extra={"shard": str(i)},
                             tenant_of=tenant_of_cmd)
         shard._metric_labels = {"shard": str(i)}
+        if self._audit:
+            shard.enable_audit(plane=f"ks-{i}")
         return shard
+
+    def enable_audit(self) -> None:
+        """Opt every shard plane into the live divergence audit
+        (crdt_tpu.obs.audit), labeled ``ks-<i>``.  Planes built later —
+        reshard cutover, restore reshape — inherit the opt-in and
+        re-mint their digests from their rebuilt stores (epoch-fenced:
+        cross-epoch digests are never compared because cross-epoch
+        gossip is already 409-fenced)."""
+        self._audit = True
+        for i, shard in enumerate(self.shards):
+            shard.enable_audit(plane=f"ks-{i}")
+
+    def audit_snapshot(self, shard: int):
+        """One-lock (vv, frontier, digest) snapshot of one shard plane —
+        the /ks/gossip piggyback source (api.http_shim)."""
+        return self.shards[shard].audit_snapshot()
 
     # ---- online resharding (keyspace/reshard.py drives these) ----
 

@@ -1,5 +1,6 @@
-"""CLI entry: ``python -m crdt_tpu.obs assemble <logs...>`` and
-``python -m crdt_tpu.obs fleet <members...>``."""
+"""CLI entry: ``python -m crdt_tpu.obs assemble <logs...>``,
+``python -m crdt_tpu.obs fleet <members...>``, and
+``python -m crdt_tpu.obs audit <members...>``."""
 from __future__ import annotations
 
 import sys
@@ -13,7 +14,9 @@ def main(argv=None) -> int:
               "[--min-coverage 0.95]\n"
               "       python -m crdt_tpu.obs fleet <url-or-file ...> "
               "[--logs node.jsonl ...] [--min-coverage 95] "
-              "[--out fleet.json]")
+              "[--out fleet.json]\n"
+              "       python -m crdt_tpu.obs audit <url-or-file ...> "
+              "[--out audit.json]")
         return 0 if argv else 2
     cmd = argv.pop(0)
     if cmd == "assemble":
@@ -24,7 +27,11 @@ def main(argv=None) -> int:
         from crdt_tpu.obs.fleet import main as fleet_main
 
         return fleet_main(argv)
-    print(f"unknown subcommand {cmd!r} (only: assemble, fleet)")
+    if cmd == "audit":
+        from crdt_tpu.obs.audit import main as audit_main
+
+        return audit_main(argv)
+    print(f"unknown subcommand {cmd!r} (only: assemble, fleet, audit)")
     return 2
 
 

@@ -276,6 +276,29 @@ def fleet_summary(members: Dict[str, Snapshot]) -> Dict[str, Any]:
         for srow, total in zip(shards.values(), per_shard):
             srow["ops_total"] = total
 
+    # divergence-audit rollup (crdt_tpu.obs.audit): per-plane agreement
+    # as seen by every member's watchdog, plus the fleet-total divergence
+    # and scrub-drift counts.  ``state`` is the worst member state (0 no
+    # data / 1 ok / 2 divergence latched) — the one-number fleet verdict.
+    audit: Dict[str, Any] = {"states": {}, "planes": {},
+                             "divergences": 0, "scrub_drifts": 0}
+    for member, snap in members.items():
+        for labels, v in snap.gauges_named("audit_state"):
+            audit["states"][member] = max(
+                int(v), audit["states"].get(member, 0))
+        for labels, v in snap.gauges_named("audit_agreement"):
+            plane = labels.get("plane", "host")
+            prow = audit["planes"].setdefault(
+                plane, {"agree": [], "disagree": []})
+            prow["agree" if v >= 1.0 else "disagree"].append(member)
+        for _, v in snap.counters_named("audit_divergences"):
+            audit["divergences"] += int(v)
+        for _, v in snap.counters_named("audit_scrub_drifts"):
+            audit["scrub_drifts"] += int(v)
+    audit["state"] = max(audit["states"].values(), default=0)
+    audit["planes"] = {p: audit["planes"][p]
+                       for p in sorted(audit["planes"])}
+
     slots: Dict[str, Dict[str, Any]] = {}
     for member, snap in members.items():
         states = {tuple(sorted(l.items())): v
@@ -299,6 +322,7 @@ def fleet_summary(members: Dict[str, Snapshot]) -> Dict[str, Any]:
         "shards": {s: shards[s] for s in sorted(shards, key=int)},
         "shard_balance": balance,
         "slots": {s: slots[s] for s in sorted(slots, key=int)},
+        "audit": audit,
     }
 
 

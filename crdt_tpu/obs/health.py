@@ -251,6 +251,38 @@ def sample_leases(registry, node_label: str, leases) -> None:
                            slot=str(slot), node=node_label)
 
 
+def max_convergence_lag(registry):
+    """The worst ``convergence_lag_ops`` EWMA across every node label in
+    this registry, or None before the first pull-round observation — the
+    signal the AuditWatchdog's lag-breach evaluator thresholds on."""
+    worst = None
+    for key, val in registry.snapshot().items():
+        if key == "convergence_lag_ops" or \
+                key.startswith("convergence_lag_ops{"):
+            v = float(val)
+            if worst is None or v > worst:
+                worst = v
+    return worst
+
+
+def sample_audit(registry, watchdog) -> None:
+    """Divergence-audit gauges (crdt_tpu.obs.audit), scrape-fresh:
+    ``audit_state`` (0 no data / 1 comparisons all agree / 2 divergence
+    latched), ``audit_evals`` (watchdog ticks so far — zero over a long
+    run means the evaluators never ran, which is itself the alert), and
+    per-plane ``audit_plane_keys`` (winner rows under digest).  The
+    ``audit_agreement{plane=}`` gauge and the ``crdt_audit_*_total``
+    counters are recorded by the watchdog at comparison time and render
+    from the registry without sampling here."""
+    registry.set_gauge("audit_state", float(watchdog.state))
+    registry.set_gauge("audit_evals", float(watchdog.evals))
+    for plane, node in watchdog.planes():
+        dig = getattr(node, "digest", None)
+        if dig is not None:
+            registry.set_gauge("audit_plane_keys", float(len(dig.winner)),
+                               plane=plane)
+
+
 def sample_race_watch(registry) -> None:
     """Witnessed-race detector gauges (analysis.verify.race): the current
     witness count plus per-watchpoint read/write traffic, so a soak run
@@ -297,7 +329,7 @@ def sample_union_paths(registry) -> None:
 def sample_all(registry, node, set_node=None, seq_node=None,
                map_node=None, composite_node=None, agent=None,
                ingest=None, stability=None, keyspace=None,
-               ks_door=None, leases=None) -> None:
+               ks_door=None, leases=None, watchdog=None) -> None:
     sample_kv_node(registry, node)
     sample_union_paths(registry)
     if set_node is not None:
@@ -318,17 +350,21 @@ def sample_all(registry, node, set_node=None, seq_node=None,
         sample_keyspace(registry, str(node.rid), keyspace, ks_door=ks_door)
     if leases is not None:
         sample_leases(registry, str(node.rid), leases)
+    if watchdog is not None:
+        sample_audit(registry, watchdog)
 
 
 def render_node_metrics(node, set_node=None, seq_node=None,
                         map_node=None, composite_node=None,
                         agent=None, ingest=None, stability=None,
-                        keyspace=None, ks_door=None, leases=None) -> str:
+                        keyspace=None, ks_door=None, leases=None,
+                        watchdog=None) -> str:
     """The GET /metrics body: sample health gauges into the node's
     registry, then render the whole registry as Prometheus text."""
     registry = node.metrics.registry
     sample_all(registry, node, set_node=set_node, seq_node=seq_node,
                map_node=map_node, composite_node=composite_node,
                agent=agent, ingest=ingest, stability=stability,
-               keyspace=keyspace, ks_door=ks_door, leases=leases)
+               keyspace=keyspace, ks_door=ks_door, leases=leases,
+               watchdog=watchdog)
     return registry.render_prometheus()

@@ -1,0 +1,215 @@
+"""Order-independent 128-bit state digest kernel (the audit plane's core).
+
+A replica's auditable state is the set of canonical ``(key, winner-ts,
+rid, seq)`` rows — one per key, the LWW winner.  The digest of that set
+is four independent 32-bit lanes, each the sum mod 2**32 of a per-row
+mixed hash.  Addition is commutative and invertible, which buys the two
+properties the audit plane is built on:
+
+* **order independence** — replicas that hold the same row set produce
+  the same digest no matter what order ops arrived in;
+* **O(delta) maintenance** — when a key's winner changes, subtract the
+  old row's lanes and add the new row's lanes; no rescan.
+
+Per-row hashing happens in two stages so the device never touches
+strings: the KEY contributes 4 lanes of ``blake2b(key, 16)`` computed
+host-side once per distinct key (cached by the caller), and the
+``(ts, rid, seq)`` ident is whitened into each lane with a splitmix-style
+uint32 finalizer written generically over numpy/jnp — uint32 arithmetic
+wraps identically in both, so host and device row hashes agree
+bit-for-bit.  The lane-sum fold (``lane_sum``) is a plain masked/padded
+reduction the mesh plane runs inside its one fused merge dispatch:
+padding rows carry all-zero lanes and vanish under addition, so no mask
+tensor is needed.
+
+128 bits (4 lanes * 32) keeps accidental collision probability far below
+anything a soak can hit while staying native-width on TPU/CPU alike;
+the lanes use distinct salts so they are independent hash functions, not
+one hash truncated four ways.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+
+LANES = 4
+
+# per-lane whitening salts (distinct odd constants; any fixed values work,
+# these are from the splitmix64 increment's 32-bit halves and friends)
+LANE_SALTS = np.array(
+    [0x9E3779B9, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F], dtype=np.uint32)
+
+_MASK64 = (1 << 64) - 1
+
+
+def mix32(x):
+    """splitmix32-style finalizer, generic over numpy/jnp uint32 arrays.
+
+    Every op here (xor, shift, wrap-around multiply) is defined
+    identically for numpy and jax uint32 arrays, so the same call is the
+    host reference AND the traced device kernel.
+    """
+    c1 = x.dtype.type(0x7FEB352D)
+    c2 = x.dtype.type(0x846CA68B)
+    x = x ^ (x >> 16)
+    x = x * c1
+    x = x ^ (x >> 15)
+    x = x * c2
+    x = x ^ (x >> 16)
+    return x
+
+
+def rotl32(x, r: int):
+    """Rotate-left on uint32 arrays (numpy or jnp); r must be 1..31."""
+    return (x << r) | (x >> (32 - r))
+
+
+def key_lanes(key: str) -> np.ndarray:
+    """4 uint32 lanes of blake2b-128 over the key bytes (host-side only;
+    callers cache per distinct key — the device consumes the lanes)."""
+    raw = hashlib.blake2b(key.encode("utf-8"), digest_size=16).digest()
+    return np.frombuffer(raw, dtype="<u4").astype(np.uint32)
+
+
+def fold_ts(ts: int) -> int:
+    """Fold a (possibly 64-bit, possibly negative) timestamp into the
+    uint32 domain: xor-fold the high half so absolute-ms clocks keep
+    their entropy."""
+    t = ts & _MASK64
+    return (t ^ (t >> 32)) & 0xFFFFFFFF
+
+
+def row_lanes(klanes, ts, rid, seq):
+    """Per-row digest lanes, generic over numpy/jnp.
+
+    ``klanes``: uint32[..., 4] key lanes; ``ts``/``rid``/``seq``: uint32
+    arrays broadcastable to ``klanes[..., 0]`` (fold 64-bit timestamps
+    through ``fold_ts`` first; cast signed ids via ``.astype(uint32)`` —
+    two's-complement reinterpretation is fine, it just has to be the
+    same on both sides).  Returns uint32[..., 4].
+    """
+    ident = ts ^ rotl32(rid, 7) ^ rotl32(seq, 13)
+    lanes = mix32(ident[..., None] ^ LANE_SALTS)
+    return mix32(klanes ^ lanes)
+
+
+def lane_sum(rows):
+    """Sum rows' lanes mod 2**32: uint32[..., n, 4] -> uint32[..., 4].
+
+    Generic over numpy/jnp (explicit dtype pins the wrap-around sum —
+    numpy would otherwise widen to uint64).  All-zero padding rows are
+    additive identity, so padded batches need no mask.
+    """
+    return rows.sum(axis=-2, dtype=rows.dtype)
+
+
+def row_lanes_one(klanes: np.ndarray, ts: int, rid: int, seq: int
+                  ) -> np.ndarray:
+    """Host scalar-row convenience: one (key, ts, rid, seq) row's lanes."""
+    u = np.array([fold_ts(ts), rid & 0xFFFFFFFF, seq & 0xFFFFFFFF],
+                 dtype=np.uint32)
+    return row_lanes(klanes, u[0], u[1], u[2])
+
+
+# ---- pure-int host mirror of the row hash ----
+#
+# The incremental digest pays one row hash per accepted op on the ingest
+# hot path; spinning up uint32 ndarrays per row costs ~13us each where
+# the same math on plain Python ints is well under 1us.  These mirrors
+# are pinned bit-equal to the array versions by the property tests —
+# lanes travel as 4-int tuples and re-enter numpy only at the device
+# boundary (dig_column / digest_hex, both of which accept either form).
+
+LANE_SALTS_INT: Tuple[int, int, int, int] = tuple(int(s) for s in LANE_SALTS)
+
+ZERO_INTS: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+_M32 = 0xFFFFFFFF
+
+
+def mix32_int(x: int) -> int:
+    """``mix32`` on one plain int (callers pre-mask to 32 bits)."""
+    x ^= x >> 16
+    x = (x * 0x7FEB352D) & _M32
+    x ^= x >> 15
+    x = (x * 0x846CA68B) & _M32
+    x ^= x >> 16
+    return x
+
+
+def key_lanes_ints(key: str) -> Tuple[int, int, int, int]:
+    """``key_lanes`` as a 4-int tuple (host cache form)."""
+    return tuple(int(v) for v in key_lanes(key))
+
+
+def row_lanes_ints(klanes: Tuple[int, int, int, int], ts: int, rid: int,
+                   seq: int) -> Tuple[int, int, int, int]:
+    """``row_lanes_one`` on plain ints — same bits, no ndarray churn."""
+    r = rid & _M32
+    s = seq & _M32
+    ident = (fold_ts(ts)
+             ^ (((r << 7) | (r >> 25)) & _M32)
+             ^ (((s << 13) | (s >> 19)) & _M32))
+    return (
+        mix32_int(klanes[0] ^ mix32_int(ident ^ LANE_SALTS_INT[0])),
+        mix32_int(klanes[1] ^ mix32_int(ident ^ LANE_SALTS_INT[1])),
+        mix32_int(klanes[2] ^ mix32_int(ident ^ LANE_SALTS_INT[2])),
+        mix32_int(klanes[3] ^ mix32_int(ident ^ LANE_SALTS_INT[3])),
+    )
+
+
+def add_lanes_ints(acc, rows):
+    """acc + rows (mod 2**32) on 4-int tuples."""
+    return ((acc[0] + rows[0]) & _M32, (acc[1] + rows[1]) & _M32,
+            (acc[2] + rows[2]) & _M32, (acc[3] + rows[3]) & _M32)
+
+
+def sub_lanes_ints(acc, rows):
+    """acc - rows (mod 2**32) on 4-int tuples (the supersede path)."""
+    return ((acc[0] - rows[0]) & _M32, (acc[1] - rows[1]) & _M32,
+            (acc[2] - rows[2]) & _M32, (acc[3] - rows[3]) & _M32)
+
+
+def add_lanes(acc: np.ndarray, rows: np.ndarray) -> np.ndarray:
+    """acc + rows (mod 2**32), host-side."""
+    return (acc + rows).astype(np.uint32)
+
+
+def sub_lanes(acc: np.ndarray, rows: np.ndarray) -> np.ndarray:
+    """acc - rows (mod 2**32), host-side (the supersede path)."""
+    return (acc - rows).astype(np.uint32)
+
+
+def zero_lanes() -> np.ndarray:
+    return np.zeros(LANES, dtype=np.uint32)
+
+
+def digest_hex(acc) -> str:
+    """Wire form: 32 lowercase hex chars, lane 0 first.  Accepts either
+    lane form (uint32 ndarray or 4-int tuple)."""
+    return "".join(f"{int(v) & 0xFFFFFFFF:08x}" for v in acc)
+
+
+def parse_digest_hex(s: object) -> Optional[np.ndarray]:
+    """Parse the wire form back to lanes; None on anything malformed
+    (peer digests arrive over faultable transports — garbage is simply
+    'no digest', never an exception on the audit path)."""
+    if not isinstance(s, str) or len(s) != 8 * LANES:
+        return None
+    try:
+        vals = [int(s[i * 8:(i + 1) * 8], 16) for i in range(LANES)]
+    except ValueError:
+        return None
+    return np.array(vals, dtype=np.uint32)
+
+
+def digest_rows(rows: Iterable[Tuple[np.ndarray, int, int, int]]
+                ) -> np.ndarray:
+    """From-scratch host reference: fold (klanes, ts, rid, seq) rows.
+    The property tests pin the incremental accumulator against this."""
+    acc = zero_lanes()
+    for klanes, ts, rid, seq in rows:
+        acc = add_lanes(acc, row_lanes_one(klanes, ts, rid, seq))
+    return acc

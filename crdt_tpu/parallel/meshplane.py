@@ -182,13 +182,19 @@ class MeshPlane:
         else:  # vmap: single-device fusion
             run = vfold
 
-        def step(logs, cols):
+        def step(logs, cols, digs):
             merged, n_unique = run(logs, cols)
+            # audit-digest fold riding the SAME dispatch (crdt_tpu.obs
+            # .audit): per-lane sum of the batch's digest rows mod 2**32.
+            # Padding rows carry all-zero lanes (additive identity), so
+            # no mask tensor is needed; commit() bit-compares this
+            # against the host-side sum (mesh-vs-host digest parity).
+            dig_sum = jnp.sum(digs, axis=1, dtype=jnp.uint32)
             # unstack INSIDE the program: the caller gets S per-lane logs
             # from the one compiled call, no per-lane slice dispatches
             lanes = [jax.tree.map(lambda x, i=i: x[i], merged)
                      for i in range(n)]
-            return lanes, n_unique
+            return lanes, n_unique, dig_sum
 
         return jax.jit(step)
 
@@ -245,11 +251,13 @@ class MeshPlane:
                 jnp.stack([_pad_col(p.ops, name, p.fresh, batch_cap)
                            for p in pendings])
                 for name in _BATCH_COLS)
+            digs = np.stack([_pad_dig(p.dig, batch_cap) for p in pendings])
 
             step = self._step_for(cap, batch_cap)
             with self.metrics.timer("merge"):
-                lanes, n_unique = step(logs, cols)
-                n_host = np.asarray(n_unique)  # ONE host sync for all lanes
+                lanes, n_unique, dig_sum = step(logs, cols, digs)
+                # ONE host sync for all lanes' counts AND digest sums
+                n_host, dig_host = jax.device_get((n_unique, dig_sum))
         except Exception:
             # engine failure: land every lane with its own inline host
             # dispatch so no lane is left with indexes ahead of its log
@@ -265,7 +273,9 @@ class MeshPlane:
         first_exc: Optional[BaseException] = None
         for i, p in enumerate(pendings):
             try:
-                total += p.commit(lanes[i], int(n_host[i]))
+                total += p.commit(
+                    lanes[i], int(n_host[i]),
+                    digest=dig_host[i] if p.dig_sum is not None else None)
             except BaseException as exc:
                 # commit's finally released THIS lane's lock; keep
                 # committing the siblings so none of their locks leak,
@@ -312,4 +322,15 @@ def _pad_col(
         out = np.full(cap, SENTINEL, np.int32)
     if fresh:
         out[:fresh] = ops[name]
+    return out
+
+
+def _pad_dig(dig: Optional[np.ndarray], cap: int) -> np.ndarray:
+    """One lane's audit-digest rows zero-padded to ``cap`` (zeros are the
+    lane sum's additive identity — see crdt_tpu.ops.digest.lane_sum).
+    A lane with the audit plane off contributes all-zeros; its commit is
+    then called with digest=None so no spurious parity check runs."""
+    out = np.zeros((cap, 4), np.uint32)
+    if dig is not None and len(dig):
+        out[:len(dig)] = dig
     return out

@@ -80,6 +80,8 @@ def save_node(path: str, node, set_node=None, seq_node=None,
     adds ``leases.json`` — the per-slot fence floors, persisted fail-stop
     like quorum-acked writes so a rebooted replica keeps refusing the
     stale fences it refused before."""
+    from crdt_tpu.obs import audit as audit_mod
+
     p = pathlib.Path(path)
     p.mkdir(parents=True, exist_ok=True)
     if set_node is not None:
@@ -108,6 +110,10 @@ def save_node(path: str, node, set_node=None, seq_node=None,
                 "seq": shard._seq.count,
                 "epoch_ms": shard.clock.epoch_ms,
                 "payload": payload or {},
+                # state digest over the shard's stores (crdt_tpu.obs
+                # .audit): restore recomputes and compares — a mismatch
+                # is the corruption signal, not a best-effort warning
+                "audit_digest": audit_mod.store_digest_hex(shard),
             }))
         # the reshard crash-recovery ledger: {epoch, phase, target,
         # n_shards}.  Manifest-covered like every other section, so a
@@ -138,6 +144,10 @@ def save_node(path: str, node, set_node=None, seq_node=None,
         ],
         "frontier": [[r, s] for r, s in node._frontier.items()],
         "summary": node._summary,
+        # state digest over the node's stores (crdt_tpu.obs.audit):
+        # restore recomputes it from what actually loaded — a mismatch
+        # raises, and load_latest_node quarantines the generation
+        "audit_digest": audit_mod.store_digest_hex(node),
     }
     (p / "meta.json").write_text(json.dumps(meta))
 
@@ -185,6 +195,20 @@ def restore_node(path: str, node, allow_rid_change: bool = False,
     node._frontier = {int(r): int(s) for r, s in meta.get("frontier", [])}
     node._summary = meta.get("summary", {})
     node._rebuild_indexes_locked()  # delta indexes + summary-cache invalidation
+    # digest verification (crdt_tpu.obs.audit): recompute over what
+    # actually loaded and hold it against the digest saved with the
+    # snapshot — a mismatch is store corruption the SHA-256 manifest
+    # cannot see (it vouches for the files, not for the load), and the
+    # raise routes to load_latest_node's quarantine→generation fallback
+    want = meta.get("audit_digest")
+    if want is not None:
+        from crdt_tpu.obs import audit as audit_mod
+
+        got = audit_mod.store_digest_hex(node)
+        if got != want:
+            raise ValueError(
+                f"meta.json: restored state digest {got} != snapshot "
+                f"digest {want} (store corrupted in the round trip)")
     if set_node is not None and (p / "set.json").exists():
         set_node.from_snapshot(json.loads((p / "set.json").read_text()))
     if seq_node is not None and (p / "seq.json").exists():
@@ -226,6 +250,16 @@ def restore_node(path: str, node, allow_rid_change: bool = False,
                 raise ValueError(
                     f"ks-shard-{i}.json: payload must be a wire dict, "
                     f"got {type(payload).__name__}")
+            # adopt the snapshot's clock epoch BEFORE the replay:
+            # receive() rebases absolute wire timestamps onto the
+            # current epoch, so replaying under the fresh boot's epoch
+            # and swapping in the saved one afterwards would shift
+            # every restored op's absolute timestamp by the wall-clock
+            # gap between boots — a rebooted replica silently
+            # disagreeing with its peers about ops it already acked
+            # (the digest check below is what caught this)
+            shard.clock.epoch_ms = int(
+                snap.get("epoch_ms", shard.clock.epoch_ms))
             # receive() validates like a gossip body — a corrupt shard
             # section raises here and load_latest_node quarantines the
             # whole generation, exactly the composite's posture.  The
@@ -243,8 +277,17 @@ def restore_node(path: str, node, allow_rid_change: bool = False,
                 # fresh-rid boot keeps its zero-based counter (the old
                 # rid's ops are a frozen foreign-writer prefix)
                 shard._seq.count = int(snap.get("seq", 0))
-            shard.clock.epoch_ms = int(
-                snap.get("epoch_ms", shard.clock.epoch_ms))
+            # same digest verification as the host meta (the replay is
+            # absolute-ts-exact now that it runs under the saved epoch)
+            want = snap.get("audit_digest")
+            if want is not None:
+                from crdt_tpu.obs import audit as audit_mod
+
+                got = audit_mod.store_digest_hex(shard)
+                if got != want:
+                    raise ValueError(
+                        f"ks-shard-{i}.json: restored state digest "
+                        f"{got} != snapshot digest {want}")
         if rs_snap is not None:
             # after the planes are loaded: a MIGRATE ledger re-enters
             # the window against the restored state (deterministic

@@ -169,6 +169,15 @@ class ClusterConfig:
     # ingest_retry_after_s is for the 429 shed path
     consistency_retry_after_s: float = 0.05
 
+    # ---- live divergence audit plane (crdt_tpu.obs.audit) ----
+    # run the node's AuditWatchdog evaluators (store scrub cadence,
+    # frontier stall, convergence-lag breach, lease zombies) every N
+    # background gossip rounds; 0 = only explicit watchdog.evaluate()
+    # calls (deterministic drivers — soaks, tests — tick it themselves).
+    # Digest maintenance and peer comparison are NOT gated by this: they
+    # ride every gossip round's piggybacked summaries regardless.
+    audit_eval_every: int = 8
+
     def __post_init__(self) -> None:
         # keyspace knobs fail the BOOT with a named fix, not the first
         # million-key write (the PR 10 pinned-engine convention)
@@ -226,6 +235,11 @@ class ClusterConfig:
             raise ValueError(
                 f"consistency_retry_after_s={self.consistency_retry_after_s}"
                 " must be a non-negative advisory backoff")
+        if int(self.audit_eval_every) < 0:
+            raise ValueError(
+                f"audit_eval_every={self.audit_eval_every} is negative; "
+                "use 0 to leave watchdog ticks to explicit drivers or a "
+                "positive gossip-round cadence")
 
     def ports(self) -> List[int]:
         return [self.base_port + i for i in range(self.n_replicas)]

@@ -1,0 +1,524 @@
+"""Live divergence audit plane tests (crdt_tpu.obs.audit +
+crdt_tpu.ops.digest).
+
+The plane's contract has two halves and the tests pin both:
+
+* **no false positives** — the digest is order-independent and the
+  frontier clamp makes it delivery-schedule-independent, so correct
+  replicas NEVER disagree at a shared frontier (duplicates, reorders,
+  clock skew, in-flight ops notwithstanding), and the incremental
+  accumulator never drifts from the from-scratch recompute across any
+  state transition (merge, fold, summary adoption, checkpoint restore);
+
+* **no false negatives for the planted class** — a silent winner-ts
+  flip behind the digest's back is convicted by the scrub, surfaces as
+  a ``divergence_detected`` event at the shared frontier, latches the
+  watchdog at AUDIT_DIVERGED, and auto-captures exactly one postmortem
+  bundle carrying the digest witnesses.
+
+The wire side (digest piggybacked on the existing gossip response's
+stability header — zero new round trips) is pinned against a live
+NodeHost; the full fleet-scale chain runs in the nemesis soak's
+``--audit`` arm.
+"""
+from __future__ import annotations
+
+import json
+import random
+import tarfile
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from crdt_tpu.api.node import ReplicaNode, pull_round
+from crdt_tpu.obs import audit
+from crdt_tpu.obs.registry import MetricsRegistry
+from crdt_tpu.obs.trace import mint_trace_id
+from crdt_tpu.ops import digest as digops
+from crdt_tpu.utils import checkpoint as ckpt
+from crdt_tpu.utils.clock import HostClock
+from crdt_tpu.utils.metrics import Metrics
+
+
+def _node(rid: int, clock: HostClock | None = None) -> ReplicaNode:
+    return ReplicaNode(rid=rid, capacity=64, clock=clock or HostClock(),
+                       metrics=Metrics(registry=MetricsRegistry()))
+
+
+def _pull(dst: ReplicaNode, src: ReplicaNode, fetch=None) -> None:
+    pull_round(dst, fetch or src.gossip_payload, dst.metrics, delta=True,
+               peer=str(src.rid), trace=mint_trace_id(dst.rid))
+
+
+# ---------------------------------------------------------------- kernel
+
+
+def test_row_hash_int_mirror_and_device_trace_bit_equal():
+    """The three row-hash forms — pure-int host mirror, numpy scalar,
+    and the jnp-traced kernel the mesh plane folds — agree bit-for-bit
+    on random rows (negative rids and 64-bit timestamps included), and
+    the lane fold commutes with batching on both backends."""
+    import jax.numpy as jnp
+
+    rng = random.Random(0xD16E57)
+    rows = []
+    for _ in range(64):
+        key = f"k{rng.randrange(50)}"
+        ts = rng.randrange(-2 ** 40, 2 ** 62)
+        rid = rng.randrange(-5, 2 ** 31)
+        seq = rng.randrange(0, 2 ** 33)
+        rows.append((key, ts, rid, seq))
+
+    np_rows, int_rows = [], []
+    for key, ts, rid, seq in rows:
+        kl = digops.key_lanes(key)
+        a = digops.row_lanes_one(kl, ts, rid, seq)
+        b = digops.row_lanes_ints(digops.key_lanes_ints(key), ts, rid, seq)
+        c = digops.row_lanes(
+            jnp.asarray(kl),
+            jnp.uint32(digops.fold_ts(ts)),
+            jnp.uint32(rid & 0xFFFFFFFF),
+            jnp.uint32(seq & 0xFFFFFFFF))
+        assert tuple(int(v) for v in a) == b
+        assert tuple(int(v) for v in np.asarray(c)) == b
+        np_rows.append(a)
+        int_rows.append(b)
+
+    batch = np.stack(np_rows)
+    host_sum = digops.lane_sum(batch)
+    dev_sum = np.asarray(digops.lane_sum(jnp.asarray(batch)))
+    assert np.array_equal(host_sum, dev_sum)
+    acc = digops.ZERO_INTS
+    for r in int_rows:
+        acc = digops.add_lanes_ints(acc, r)
+    assert digops.digest_hex(host_sum) == digops.digest_hex(acc)
+
+
+def test_digest_order_independent_and_subtract_inverts():
+    rng = random.Random(7)
+    rows = [(digops.key_lanes_ints(f"k{i}"), 1000 + i, i % 3, i)
+            for i in range(20)]
+    accs = []
+    for _ in range(5):
+        rng.shuffle(rows)
+        acc = digops.ZERO_INTS
+        for kl, ts, rid, seq in rows:
+            acc = digops.add_lanes_ints(
+                acc, digops.row_lanes_ints(kl, ts, rid, seq))
+        accs.append(acc)
+    assert len(set(accs)) == 1
+    kl = digops.key_lanes_ints("x")
+    r = digops.row_lanes_ints(kl, 5, 1, 2)
+    assert digops.sub_lanes_ints(
+        digops.add_lanes_ints(accs[0], r), r) == accs[0]
+
+
+def test_digest_hex_round_trip_and_garbage_rejected():
+    acc = (1, 2, 0xFFFFFFFF, 0)
+    s = digops.digest_hex(acc)
+    assert len(s) == 32
+    assert tuple(int(v) for v in digops.parse_digest_hex(s)) == acc
+    for bad in (None, 7, "", "zz" * 16, s[:-1], s + "0"):
+        assert digops.parse_digest_hex(bad) is None
+
+
+# ------------------------------------------------- incremental upkeep
+
+
+def _assert_no_drift(node: ReplicaNode, where: str) -> None:
+    d = node.digest
+    _w, _r, acc = d.compute_from_store()
+    assert d.acc == acc, f"incremental digest drifted after {where}"
+
+
+def test_incremental_digest_survives_every_state_transition(tmp_path):
+    """acc == from-scratch recompute after local writes, merges, a
+    compaction fold, summary adoption by a revived peer, and a
+    checkpoint save/restore round trip — the transitions the soak's
+    scrub oracle sweeps at fleet scale."""
+    clock = HostClock()
+    a, b = _node(0, clock), _node(1, clock)
+    a.enable_audit()
+    b.enable_audit()
+
+    for i in range(6):
+        a.add_command({f"k{i % 4}": str(i)}, ts=i * 10)
+    _assert_no_drift(a, "local writes")
+    _pull(b, a)
+    _assert_no_drift(b, "merge")
+    b.add_command({"k9": "peer"}, ts=100)
+    _pull(a, b)
+    _assert_no_drift(a, "cross merge")
+
+    f = a.version_vector()
+    a.compact(f)
+    _assert_no_drift(a, "fold")
+
+    # summary adoption: a fresh node pulls from the compacted one and
+    # adopts its frontier+summary wholesale
+    fresh = _node(2, clock)
+    fresh.enable_audit()
+    _pull(fresh, a, fetch=lambda since=None: a.gossip_payload())
+    _assert_no_drift(fresh, "summary adoption")
+    assert fresh.audit_digest_at(f) == a.audit_digest_at(f)
+
+    # checkpoint round trip rebuilds the digest from the restored store
+    ckpt.save_node_atomic(str(tmp_path / "ck"), a)
+    restored = _node(0, HostClock())
+    restored.enable_audit()
+    assert ckpt.load_latest_node(str(tmp_path / "ck"), restored)
+    _assert_no_drift(restored, "checkpoint restore")
+    assert restored.audit_digest_at(f) == a.audit_digest_at(f)
+
+
+# ------------------------------------------------- frontier clamp
+
+
+def test_frontier_clamp_comparable_under_skew_and_inflight_ops():
+    """Replicas whose clocks disagree by seconds and whose op sets
+    differ ABOVE the frontier still produce bit-identical digests AT
+    the frontier; outside the soundness window (F below our compaction
+    frontier, F ahead of our vv) the clamp refuses instead of lying."""
+    a = _node(0, HostClock(epoch_ms=1_000_000))
+    b = _node(1, HostClock(epoch_ms=1_004_321))  # 4.3s of skew
+    a.enable_audit()
+    b.enable_audit()
+    for i in range(5):
+        a.add_command({f"k{i}": str(i)}, ts=i * 10)
+    b.add_command({"kb": "1"}, ts=7)
+    _pull(b, a)
+    _pull(a, b)
+    f = a.version_vector()
+    assert f == b.version_vector()
+    a.compact(f)
+    b.compact(f)
+    assert a.audit_digest_at(f) == b.audit_digest_at(f) is not None
+
+    # in-flight ops above F do not move the clamped digest
+    before = a.audit_digest_at(f)
+    a.add_command({"k0": "newer"}, ts=500)
+    b.add_command({"zz": "other"}, ts=600)
+    assert a.audit_digest_at(f) == before
+    assert b.audit_digest_at(f) == before
+
+    # refusal outside the window: ahead of vv / behind our own fold
+    ahead = {r: s + 10 for r, s in a.version_vector().items()}
+    assert a.audit_digest_at(ahead) is None
+    assert a.audit_digest_at({}) is None  # below the compaction frontier
+
+
+def test_duplicate_and_reordered_delivery_no_false_positive():
+    """The guard the clamp exists for: one peer receives the payload
+    TWICE, another receives it split in reverse order — all three
+    digests agree at the shared frontier and the watchdog stays
+    AUDIT_OK with zero divergences."""
+    clock = HostClock()
+    a, b, c = _node(0, clock), _node(1, clock), _node(2, clock)
+    for n in (a, b, c):
+        n.enable_audit()
+    for i in range(8):
+        a.add_command({f"k{i % 5}": str(i)}, ts=i * 10)
+
+    full = a.gossip_payload()
+    _pull(b, a)
+    _pull(b, a, fetch=lambda since=None: dict(full))  # duplicate delivery
+    items = sorted(full.items())
+    part1 = dict(items[: len(items) // 2])
+    part2 = dict(items[len(items) // 2:])
+    _pull(c, a, fetch=lambda since=None: dict(part2))  # reordered halves
+    _pull(c, a, fetch=lambda since=None: dict(part1))
+
+    f = a.version_vector()
+    for n in (a, b, c):
+        assert n.version_vector() == f
+        n.compact(f)
+    assert a.audit_digest_at(f) == b.audit_digest_at(f) \
+        == c.audit_digest_at(f)
+
+    wd = audit.AuditWatchdog(b)
+    for peer in (a, c):
+        _vv, frontier, dig = peer.audit_snapshot()
+        wd.note_host(f"http://{peer.rid}", frontier, dig)
+    assert wd.state == audit.AUDIT_OK
+    assert wd.divergences == []
+    reg = b.metrics.registry
+    assert reg.gauge_value("audit_state") == audit.AUDIT_OK
+    assert reg.gauge_value("audit_agreement", plane="host") == 1.0
+
+
+# ------------------------------------------------- planted divergence
+
+
+def test_planted_flip_convicted_detected_and_postmortem(tmp_path):
+    """The 1:1 chain on two live nodes: plant a silent winner-ts flip
+    on a, the scrub convicts it (and ONLY it — b scrubs clean), b's
+    watchdog sees the disagreement at the shared frontier, emits
+    divergence_detected, latches AUDIT_DIVERGED, and writes exactly one
+    postmortem bundle carrying the digest witnesses."""
+    clock = HostClock()
+    a, b = _node(0, clock), _node(1, clock)
+    a.enable_audit()
+    b.enable_audit()
+    for i in range(6):
+        a.add_command({f"k{i % 3}": str(i)}, ts=i * 10)
+    _pull(b, a)
+    f = a.version_vector()
+    a.compact(f)
+    b.compact(f)
+
+    log = tmp_path / "events.jsonl"
+    log.write_text(json.dumps({"event": "boot", "node": "1"}) + "\n")
+    wd = audit.AuditWatchdog(b)
+    wd.configure_postmortem(str(tmp_path), seed=7, log_paths=[str(log)])
+
+    # agreement first: the divergence below must be a state CHANGE
+    _vv, fr, dig = a.audit_snapshot()
+    wd.note_host("http://a", fr, dig)
+    assert wd.state == audit.AUDIT_OK
+
+    witness = audit.plant_divergence(a)
+    assert witness is not None and witness["ts_after"] > witness["ts_before"]
+    # the flip is invisible until the scrub adopts it into the served
+    # digest; b's own store is untouched and must scrub clean
+    assert a.audit_scrub() is True
+    assert b.audit_scrub() is False
+
+    _vv, fr2, dig2 = a.audit_snapshot()
+    assert fr2 == fr and dig2 != dig
+    wd.note_host("http://a", fr2, dig2)
+
+    assert wd.state == audit.AUDIT_DIVERGED
+    [div] = wd.divergences
+    assert div["plane"] == "host"
+    assert {div["a"], div["b"]} == {"http://a", "local"}
+    [ev] = list(b.events.find(event="divergence_detected"))
+    assert ev["plane"] == "host"
+    assert b.metrics.registry.gauge_value("audit_state") \
+        == audit.AUDIT_DIVERGED
+
+    bundle = tmp_path / "postmortem-7.tar.gz"
+    assert wd.postmortem_path == str(bundle) and bundle.exists()
+    with tarfile.open(bundle) as tf:
+        names = tf.getnames()
+        member = next(n for n in names if n.endswith("audit_witnesses.json"))
+        wit = json.loads(tf.extractfile(member).read())
+    assert wit["divergence"]["plane"] == "host"
+    assert wit["planes"]["host"]["digest"] in (dig, dig2)
+
+    # latched: a second disagreeing frontier adds provenance but never a
+    # second bundle, and the state cannot un-diverge
+    a.add_command({"k0": "more"}, ts=900)
+    _pull(b, a)
+    f3 = a.version_vector()
+    a.compact(f3)
+    b.compact(f3)
+    _vv, fr3, dig3 = a.audit_snapshot()
+    wd.note_host("http://a", fr3, dig3)
+    assert wd.state == audit.AUDIT_DIVERGED
+    assert wd.postmortem_path == str(bundle)
+    assert len(list(tmp_path.glob("postmortem-*.tar.gz"))) == 1
+
+
+def test_plant_divergence_is_rid_keyed_and_value_invisible():
+    """Two replicas planting 'the same' corruption must NOT agree on
+    the wrong answer: the bump is rid-keyed, so same-key plants on
+    different nodes produce different wrong digests (a fixed bump would
+    manufacture consistently-wrong-but-agreeing replicas the audit
+    plane could never catch).  And the plant never touches values —
+    get_state stays identical, only the audit plane can see it."""
+    clock = HostClock()
+    a, b = _node(0, clock), _node(1, clock)
+    a.enable_audit()
+    b.enable_audit()
+    a.add_command({"k": "v"}, ts=10)
+    _pull(b, a)
+    f = a.version_vector()
+    a.compact(f)
+    b.compact(f)
+    state_before = a.get_state()
+
+    wa = audit.plant_divergence(a)
+    wb = audit.plant_divergence(b)
+    assert wa["key"] == wb["key"] == "k"
+    assert wa["ts_after"] != wb["ts_after"]
+    a.audit_scrub()
+    b.audit_scrub()
+    assert a.audit_digest_at(f) != b.audit_digest_at(f)
+    assert a.get_state() == state_before  # values untouched
+
+
+# ------------------------------------------------- continuous evaluators
+
+
+def test_scrub_cadence_and_frontier_stall_edge_trigger():
+    class StubTracker:
+        def __init__(self):
+            self.stale = ["http://peer"]
+
+        def stale_members(self):
+            return list(self.stale)
+
+    n = _node(0)
+    n.enable_audit()
+    n.add_command({"k": "v"}, ts=1)
+    tracker = StubTracker()
+    wd = audit.AuditWatchdog(n, stability=tracker, scrub_every=4,
+                             stall_rounds=3)
+    for _ in range(12):
+        wd.evaluate()
+    assert wd.evals == 12 and wd.scrub_drifts == []
+    # stall fired once (edge-triggered) despite 12 stale rounds
+    stalls = list(n.events.find(event="audit_frontier_stall"))
+    assert len(stalls) == 1 and stalls[0]["stale"] == ["http://peer"]
+    # recovery re-arms the trigger
+    tracker.stale = []
+    for _ in range(3):
+        wd.evaluate()
+    tracker.stale = ["http://peer"]
+    for _ in range(3):
+        wd.evaluate()
+    assert len(list(n.events.find(event="audit_frontier_stall"))) == 2
+
+
+# ------------------------------------------------- checkpoint verification
+
+
+def test_shard_restore_preserves_absolute_ts_across_boot_epochs(tmp_path):
+    """Checkpoint round trip under REAL clocks whose epochs differ
+    between boots (the rebooted process starts later, so its fresh
+    HostClock epoch is ahead of the saved one).  The shard replay must
+    run under the SAVED epoch — replaying under the boot epoch and
+    swapping epochs afterwards shifts every restored op's absolute
+    timestamp by the boot gap, making the rebooted replica silently
+    disagree with peers about ops it acked pre-crash.  The restore-time
+    digest verification is what catches that class; this pins it with
+    an explicit row-level witness."""
+    from crdt_tpu.keyspace import ShardedKeyspace, qualify
+
+    def winners(shard):
+        pd = (shard.digest if shard.digest is not None
+              else audit.PlaneDigest(shard))
+        winner, _rows, _acc = pd.compute_from_store()
+        return winner
+
+    e1 = HostClock().epoch_ms  # first boot's wall-anchored epoch
+    host = _node(0, HostClock(epoch_ms=e1))
+    ks = ShardedKeyspace(rid=0, n_shards=2, capacity=64,
+                         clock=HostClock(epoch_ms=e1))
+    for i in range(12):
+        qkey = qualify("t", f"k{i:02d}")
+        assert ks.shards[ks.shard_of("t", f"k{i:02d}")].add_command(
+            {qkey: f"v{i}"})
+    before = [winners(s) for s in ks.shards]
+
+    path = str(tmp_path / "snap")
+    ckpt.save_node(path, host, keyspace=ks)
+
+    # the "rebooted five seconds later" incarnation
+    host2 = _node(0, HostClock(epoch_ms=e1 + 5_000))
+    ks2 = ShardedKeyspace(rid=0, n_shards=2, capacity=64,
+                          clock=HostClock(epoch_ms=e1 + 5_000))
+    ckpt.restore_node(path, host2, keyspace=ks2)  # digest check inside
+    after = [winners(s) for s in ks2.shards]
+    assert after == before  # absolute (ts, rid, seq) rows bit-identical
+    for s_old, s_new in zip(ks.shards, ks2.shards):
+        assert (audit.store_digest_hex(s_new)
+                == audit.store_digest_hex(s_old))
+
+
+def test_checkpoint_digest_mismatch_quarantines_generation(tmp_path):
+    """A snapshot whose stores were corrupted AFTER the manifest was
+    written (the class SHA-256 cannot see: the tamper re-signs) fails
+    the restore-time digest verification, is quarantined, and restore
+    falls back to the previous intact generation."""
+    root = tmp_path / "ck"
+    a = _node(0)
+    for i in range(4):
+        a.add_command({f"k{i}": str(i)}, ts=i * 10)
+    a.compact(a.version_vector())
+    good_digest = audit.store_digest_hex(a)
+    ckpt.save_node_atomic(str(root), a)
+
+    a.add_command({"k9": "newer"}, ts=100)
+    snap = ckpt.save_node_atomic(str(root), a)
+    meta_path = tmp_path / "ck" / snap.split("/")[-1] / "meta.json"
+    meta = json.loads(meta_path.read_text())
+    meta["summary"]["k0"]["ts"] = int(meta["summary"]["k0"]["ts"]) + 1
+    meta_path.write_text(json.dumps(meta))
+    ckpt.write_manifest(str(meta_path.parent))  # tamper re-signs the SHAs
+
+    restored = _node(0)
+    assert ckpt.load_latest_node(str(root), restored)
+    # the corrupt generation was quarantined with the digest as reason...
+    [q] = list(restored.events.find(event="snapshot_quarantine"))
+    assert "digest" in q["reason"]
+    # ...and the restore landed on the previous generation, intact
+    assert audit.store_digest_hex(restored) == good_digest
+    assert "k9" not in restored.get_state()
+
+
+# ------------------------------------------------- wire piggyback
+
+
+def test_gossip_response_piggybacks_digest_no_new_round_trips():
+    """The digest rides the SAME stability header every gossip response
+    already carries (frontier-paired, so the receiver compares at the
+    serving node's exact clamp), and GET /audit serves the watchdog
+    report — the fleet-scale census equality (a planted arm's wire-call
+    histogram bit-equal to a digest-free arm's) runs in the soak."""
+    from crdt_tpu.api.net import NodeHost
+    from crdt_tpu.consistency.stability import (STABILITY_HEADER,
+                                                decode_summary)
+    from crdt_tpu.utils.config import ClusterConfig
+
+    h = NodeHost(rid=0, peers=[], config=ClusterConfig())
+    threading.Thread(target=h._server.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            h.url + "/data", data=json.dumps({"k": "v"}).encode(),
+            method="POST")
+        assert urllib.request.urlopen(req, timeout=5).status == 200
+        resp = urllib.request.urlopen(h.url + "/gossip", timeout=5)
+        summary = decode_summary(resp.headers.get(STABILITY_HEADER))
+        vv, frontier, dig = h.node.audit_snapshot()
+        assert summary is not None and summary.get("digest") == dig
+
+        report = json.loads(urllib.request.urlopen(
+            h.url + "/audit", timeout=5).read())
+        assert report["node"] == "0"
+        assert report["state"] in (audit.AUDIT_NO_DATA, audit.AUDIT_OK)
+        assert "host" in report["planes"]
+        assert report["planes"]["host"]["digest"] == dig
+    finally:
+        h._server.shutdown()
+        h._server.server_close()
+
+
+# ------------------------------------------------- offline cross-check
+
+
+def test_cross_check_groups_by_exact_frontier():
+    rep = {
+        "digest": "0" * 32, "frontier": {"0": 5},
+    }
+    agree = audit.cross_check({
+        "a": {"planes": {"host": dict(rep)}},
+        "b": {"planes": {"host": dict(rep)}},
+    })
+    [row] = [r for r in agree if r["n"] == 2]
+    assert row["agree"] is True
+    bad = audit.cross_check({
+        "a": {"planes": {"host": dict(rep)}},
+        "b": {"planes": {"host": {"digest": "f" * 32,
+                                  "frontier": {"0": 5}}}},
+        "c": {"planes": {"host": {"digest": "0" * 32,
+                                  "frontier": {"0": 6}}}},  # other frontier
+    })
+    flagged = [r for r in bad if r["agree"] is False]
+    assert len(flagged) == 1
+    assert sorted(flagged[0]["digests"]) == ["a", "b"]
+    # the other-frontier report lands in its OWN single-member row —
+    # never compared against the ("0", 5) pair
+    assert any(r["n"] == 1 and r["frontier"] == {"0": 6} for r in bad)

@@ -122,6 +122,35 @@ def test_partial_coverage_is_reported_not_clamped():
     assert report["tenants"]["t-a"]["prop_coverage"] == 0.75
 
 
+def test_fleet_audit_rollup_worst_state_and_per_plane_rows():
+    """The divergence-audit rollup (crdt_tpu.obs.audit): per-member
+    watchdog states fold to the WORST as the one-number fleet verdict,
+    per-plane agreement splits members into agree/disagree, and the
+    divergence/scrub-drift counters sum fleet-wide."""
+    r0 = MetricsRegistry()
+    r0.set_gauge("audit_state", 1.0)
+    r0.set_gauge("audit_agreement", 1.0, plane="host")
+    r1 = MetricsRegistry()
+    r1.set_gauge("audit_state", 2.0)
+    r1.set_gauge("audit_agreement", 0.0, plane="host")
+    r1.set_gauge("audit_agreement", 1.0, plane="ks-0")
+    r1.inc("audit_divergences", 3.0)
+    r1.inc("audit_scrub_drifts", 1.0)
+    report = fleet.fleet_from_texts(
+        {"0": r0.render_prometheus(), "1": r1.render_prometheus()})
+    a = report["audit"]
+    assert a["state"] == 2  # worst member latches the fleet verdict
+    assert a["states"] == {"0": 1, "1": 2}
+    assert a["planes"]["host"] == {"agree": ["0"], "disagree": ["1"]}
+    assert a["planes"]["ks-0"] == {"agree": ["1"], "disagree": []}
+    assert a["divergences"] == 3 and a["scrub_drifts"] == 1
+
+    # members without the audit plane contribute nothing — not a verdict
+    clean = fleet.fleet_from_texts({"0": MetricsRegistry()
+                                    .render_prometheus()})
+    assert clean["audit"]["state"] == 0 and clean["audit"]["states"] == {}
+
+
 # ------------------------------------------------------- SLO + reconcile
 
 
